@@ -1,0 +1,66 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Runs the flagship hybrid model (sharded embedding + dense layers) on the
+available hardware and reports training throughput in examples/sec/chip.
+``vs_baseline`` compares the HYBRID engine against the pure dense-AR path
+(everything replicated, dense gradients) on the same hardware — the same
+comparison the reference's README charts make against stock
+TensorFlow/Horovod (reference README.md:27-41).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def _bench_once(run_option: str, vocab: int, dim: int, hidden: int,
+                batch: int, steps: int = 30, warmup: int = 5) -> float:
+    import parallax_tpu as parallax
+
+    import __graft_entry__ as ge
+    model = ge._flagship_model(vocab, dim, hidden)
+    cfg = parallax.Config(run_option=run_option, search_partitions=False)
+    sess, *_ = parallax.parallel_run(model, parallax_config=cfg)
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        return {
+            "ids": rng.integers(0, vocab, (batch,)).astype(np.int32),
+            "labels": rng.integers(0, vocab, (batch,)).astype(np.int32),
+        }
+
+    batches = [make_batch() for _ in range(8)]
+    for i in range(warmup):
+        sess.run("loss", feed_dict=batches[i % 8])
+    jax.block_until_ready(sess.state.params)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        sess.run("loss", feed_dict=batches[i % 8])
+    jax.block_until_ready(sess.state.params)
+    dt = time.perf_counter() - t0
+    sess.close()
+    return batch * steps / dt
+
+
+def main():
+    n_chips = jax.device_count()
+    vocab, dim, hidden, batch = 8192 * max(1, n_chips), 512, 1024, 4096
+
+    hybrid = _bench_once("HYBRID", vocab, dim, hidden, batch)
+    dense = _bench_once("AR", vocab, dim, hidden, batch)
+
+    per_chip = hybrid / n_chips
+    print(json.dumps({
+        "metric": "hybrid_train_examples_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(hybrid / dense, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Simple linear-regression driver — the smoke-test example.
+
+Parity with the reference's examples/simple/simple_driver.py:93-136: train
+y = w*x + b on synthetic data from y = 10x - 5 + noise via parallel_run,
+printing a converging loss.
+
+Run on an emulated 8-device mesh:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/simple_driver.py
+or on real TPU chips with no flags.
+"""
+
+import argparse
+
+import numpy as np
+
+import parallax_tpu as parallax
+from parallax_tpu.models import simple
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--resource_info", default=None,
+                    help="path to a resource_info file (host[: chip,...] "
+                         "per line); default: local devices")
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch_size", type=int, default=64)
+    ap.add_argument("--run_option", default="HYBRID",
+                    choices=["AR", "SHARD", "HYBRID", "MPI", "PS"])
+    args = ap.parse_args()
+
+    model = simple.build_model(learning_rate=0.1)
+    config = parallax.Config(run_option=args.run_option,
+                             search_partitions=False)
+    sess, num_workers, worker_id, num_replicas = parallax.parallel_run(
+        model, args.resource_info, sync=True, parallax_config=config)
+    print(f"workers={num_workers} worker_id={worker_id} "
+          f"replicas_per_worker={num_replicas}")
+
+    rng = np.random.default_rng(worker_id)
+    for i in range(args.steps):
+        batch = simple.make_batch(rng, args.batch_size)
+        loss, step = sess.run(["loss", "global_step"],
+                              feed_dict={"x": batch["x"], "y": batch["y"]})
+        if step % 10 == 0 or step == 1:
+            print(f"step {step}: loss {loss:.6f}")
+    out = sess.run(None, feed_dict=batch)
+    print(f"learned w={out['w']:.3f} (true 10.0)  "
+          f"b={out['b']:.3f} (true -5.0)")
+    sess.close()
+
+
+if __name__ == "__main__":
+    main()

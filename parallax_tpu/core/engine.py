@@ -1,0 +1,295 @@
+"""The hybrid parallelization engine.
+
+This is the TPU-native replacement for the reference's entire graph-transform
+layer (reference: common/graph_transform_lib.py + {ps,mpi,hybrid}/
+graph_transform.py). Where the reference rewrites a serialized MetaGraphDef —
+replicating subgraphs, inserting accumulators, token queues and Horovod ops —
+we *choose a PartitionSpec per variable* and jit the user's unmodified
+single-device step function over a device mesh; XLA emits the collectives.
+
+Routing rule (reference: common/runner.py:93-119):
+  * dense variable  -> replicated over the mesh; gradient all-reduced over
+    ICI (was: Horovod/NCCL AllReduce).
+  * sparse variable -> row-sharded over the 'shard' axis; rows exchanged via
+    all_gather/psum_scatter in ops/embedding.py (was: gRPC parameter server
+    with SparseConditionalAccumulator).
+  * run_option AR    forces everything dense  (was: MPI mode).
+  * run_option SHARD row-shards every variable whose leading dim divides the
+    shard axis — ZeRO-style sharded storage with XLA-inserted all-gathers,
+    the SPMD analogue of "all variables live on PS, workers hold mirrors"
+    (was: PS mode with replicate_variables mirrors).
+  * run_option HYBRID applies the per-variable rule; with no sparse
+    variables it degenerates to pure AR, with no dense to pure SHARD,
+    matching runner.py:93-111.
+
+Sync semantics: SPMD collectives are inherently synchronous, so the
+reference's accumulator/token-queue machinery (add_sync_op,
+graph_transform_lib.py:330-582) has no equivalent here — the all-reduce IS
+the barrier. `sync=False` (async PS) is accepted with a warning and runs
+synchronously; see SURVEY.md §7 hard-part 5.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from parallax_tpu.common import consts
+from parallax_tpu.common.config import ParallaxConfig
+from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.core import classify, mesh as mesh_lib, specs as specs_lib
+from parallax_tpu.ops import embedding
+
+
+class Model:
+    """A single-device model description — the unit the user hands to
+    `parallel_run`, replacing the reference's single-GPU tf.Graph.
+
+    * ``init_fn(rng) -> params`` — parameter pytree initializer.
+    * ``loss_fn(params, batch[, rng]) -> loss | (loss, metrics_dict)`` —
+      pure forward+loss on one logical batch.
+    * ``optimizer`` — an optax GradientTransformation (default: sgd(0.01)).
+    * ``sparse_params`` / ``dense_params`` — path-string overrides for the
+      automatic classifier (classify.py).
+    """
+
+    def __init__(self, init_fn: Callable, loss_fn: Callable,
+                 optimizer: Optional[optax.GradientTransformation] = None,
+                 sparse_params: Sequence[str] = (),
+                 dense_params: Sequence[str] = ()):
+        self.init_fn = init_fn
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer or optax.sgd(0.01)
+        self.sparse_params = tuple(sparse_params)
+        self.dense_params = tuple(dense_params)
+        try:
+            n_pos = len([
+                p for p in inspect.signature(loss_fn).parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)])
+        except (TypeError, ValueError):
+            n_pos = 2
+        self._loss_takes_rng = n_pos >= 3
+
+    def call_loss(self, params, batch, rng):
+        if self._loss_takes_rng:
+            out = self.loss_fn(params, batch, rng)
+        else:
+            out = self.loss_fn(params, batch)
+        if isinstance(out, tuple):
+            loss, metrics = out
+        else:
+            loss, metrics = out, {}
+        return loss, dict(metrics)
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+
+
+@dataclasses.dataclass
+class ShardingPlan:
+    """Resolved placement: one PartitionSpec per parameter leaf."""
+
+    mesh: Mesh
+    var_specs: Dict[str, specs_lib.VariableSpec]   # path -> classification
+    param_pspecs: Any                              # pytree of PartitionSpec
+    sharded_shapes: Tuple[Tuple[int, ...], ...]    # shapes routed to the
+                                                   # collective lookup path
+
+    def describe(self) -> str:
+        return specs_lib.summarize(self.var_specs)
+
+
+def build_plan(model: Model, mesh: Mesh, config: ParallaxConfig,
+               params_shapes, example_batch) -> ShardingPlan:
+    """Classify variables and choose PartitionSpecs (the 'graph transform')."""
+    p = mesh_lib.num_shards(mesh)
+
+    def abstract_loss(params, batch, rng):
+        return model.call_loss(params, batch, rng)[0]
+
+    rng_shape = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    var_specs = classify.classify_params(
+        abstract_loss, params_shapes, example_batch, rng_shape,
+        sparse_override=model.sparse_params,
+        dense_override=model.dense_params)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params_shapes)
+    paths = [classify._pathname(kp) for kp, _ in flat]
+
+    def choose(path, leaf) -> P:
+        shape = tuple(leaf.shape)
+        vs = var_specs[path]
+        shardable = len(shape) >= 1 and shape[0] % p == 0 and p > 1
+        if config.run_option == consts.RUN_AR:
+            return mesh_lib.replicated_spec()
+        if config.run_option == consts.RUN_SHARD:
+            return (mesh_lib.row_sharded_spec(len(shape)) if shardable
+                    else mesh_lib.replicated_spec())
+        # HYBRID
+        if vs.is_sparse and shardable:
+            return mesh_lib.row_sharded_spec(len(shape))
+        if vs.is_sparse and not shardable:
+            parallax_log.warning(
+                "sparse variable %s has leading dim %s not divisible by "
+                "shard axis %d; replicating (pad with "
+                "ops.embedding.pad_vocab to shard it)", path,
+                shape[:1], p)
+        return mesh_lib.replicated_spec()
+
+    pspecs_flat = [choose(path, leaf)
+                   for path, (_, leaf) in zip(paths, flat)]
+    param_pspecs = jax.tree_util.tree_unflatten(treedef, pspecs_flat)
+
+    # Only variables the plan actually row-sharded route through the
+    # collective lookup (so e.g. RUN_AR never pays collective costs).
+    # Routing is keyed on table shape inside the trace; warn when a dense
+    # variable shares a shape with a sharded one (it would be misrouted —
+    # numerically fine under shard_map but paying collectives it needn't).
+    sharded_shapes = tuple(
+        tuple(leaf.shape)
+        for path, ((_, leaf), spec) in zip(paths, zip(flat, pspecs_flat))
+        if var_specs[path].is_sparse
+        and spec == mesh_lib.row_sharded_spec(len(leaf.shape)))
+    for path, ((_, leaf), spec) in zip(paths, zip(flat, pspecs_flat)):
+        if (tuple(leaf.shape) in sharded_shapes
+                and not var_specs[path].is_sparse):
+            parallax_log.warning(
+                "dense variable %s shares shape %s with a row-sharded "
+                "sparse variable; its lookups (if any) would take the "
+                "collective path — pass Model(dense_params=...) shapes "
+                "apart or use embedding_lookup(sharded=False)", path,
+                tuple(leaf.shape))
+    plan = ShardingPlan(mesh, var_specs, param_pspecs, sharded_shapes)
+    parallax_log.info("sharding plan: %s (run_option=%s, shard axis=%d)",
+                      plan.describe(), config.run_option, p)
+    return plan
+
+
+class Engine:
+    """Builds and owns the compiled init/step executables for one mesh."""
+
+    def __init__(self, model: Model, mesh: Mesh, config: ParallaxConfig,
+                 example_batch):
+        self.model = model
+        self.mesh = mesh
+        self.config = config
+        if not config.sync:
+            parallax_log.warning(
+                "sync=False requested: TPU SPMD collectives are inherently "
+                "synchronous; running synchronously (the async-PS staleness "
+                "model does not exist under SPMD).")
+        rng = jax.random.PRNGKey(0)
+        params_shapes = jax.eval_shape(model.init_fn, rng)
+        batch_shapes = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), _dtype_of(x)),
+            example_batch)
+        self.plan = build_plan(model, mesh, config, params_shapes,
+                               batch_shapes)
+        self._param_shardings = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec), self.plan.param_pspecs,
+            is_leaf=lambda x: isinstance(x, P))
+        self.batch_sharding_fn = lambda leaf_ndim: NamedSharding(
+            mesh, mesh_lib.batch_spec(leaf_ndim))
+        self._build()
+
+    # -- construction ------------------------------------------------------
+
+    def _build(self):
+        model, mesh, config = self.model, self.mesh, self.config
+        param_shardings = self._param_shardings
+        avg = config.average_sparse
+        sharded_shapes = self.plan.sharded_shapes
+
+        def init_state(seed: jax.Array) -> TrainState:
+            rng = jax.random.PRNGKey(seed)
+            params = model.init_fn(rng)
+            params = jax.lax.with_sharding_constraint(params,
+                                                      param_shardings)
+            opt_state = model.optimizer.init(params)
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=opt_state,
+                              rng=jax.random.PRNGKey(seed + 1))
+
+        def train_step(state: TrainState, batch):
+            step_rng = jax.random.fold_in(state.rng, state.step)
+
+            def loss_wrap(params):
+                with embedding.sharded_lookup_scope(mesh, sharded_shapes,
+                                                    avg):
+                    return model.call_loss(params, batch, step_rng)
+
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_wrap, has_aux=True)(state.params)
+            updates, opt_state = model.optimizer.update(
+                grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            params = jax.lax.with_sharding_constraint(params,
+                                                      param_shardings)
+            new_state = state.replace(step=state.step + 1, params=params,
+                                      opt_state=opt_state)
+            outputs = {"loss": loss, "global_step": new_state.step}
+            outputs.update(metrics)
+            return new_state, outputs
+
+        self._init_jit = jax.jit(init_state)
+        self._step_jit = jax.jit(train_step, donate_argnums=0)
+        self._exported_graph = False
+
+    # -- public ops --------------------------------------------------------
+
+    def init_state(self, seed: int = 0) -> TrainState:
+        with self.mesh:
+            return self._init_jit(seed)
+
+    def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        batch = self.shard_batch(batch)
+        with self.mesh:
+            new_state, outputs = self._step_jit(state, batch)
+        if not self._exported_graph and self.config.export_graph_path:
+            self._export_graph(state, batch)
+        return new_state, outputs
+
+    def shard_batch(self, batch):
+        """Place a host batch onto the mesh, sharded on dim 0 (the
+        reference's per-replica feed splitting, session_context.py:205-233)."""
+        def put(x):
+            x = np.asarray(x)
+            return jax.device_put(x, self.batch_sharding_fn(x.ndim))
+        return jax.tree.map(put, batch)
+
+    def _export_graph(self, state, batch):
+        """Dump compiled-step HLO text (reference: export_graph_path dumps
+        the transformed MetaGraph, common/lib.py:258-264)."""
+        import os
+        self._exported_graph = True
+        try:
+            lowered = jax.jit(self._step_jit.__wrapped__,
+                              donate_argnums=0).lower(state, batch)
+            path = self.config.export_graph_path
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "train_step.stablehlo.txt"),
+                      "w") as f:
+                f.write(lowered.as_text())
+            parallax_log.info("exported compiled graph to %s", path)
+        except Exception as e:  # non-fatal observability feature
+            parallax_log.warning("graph export failed: %s", e)
+
+
+def _dtype_of(x):
+    d = getattr(x, "dtype", None)
+    if d is not None:
+        return d
+    return np.asarray(x).dtype

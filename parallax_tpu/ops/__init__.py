@@ -1,0 +1,4 @@
+from parallax_tpu.ops.embedding import (embedding_lookup, pad_vocab,
+                                        sharded_lookup_scope)
+
+__all__ = ["embedding_lookup", "pad_vocab", "sharded_lookup_scope"]

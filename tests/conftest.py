@@ -1,0 +1,33 @@
+"""Test fixtures: emulate an 8-device TPU mesh on CPU.
+
+SURVEY.md §4: the reference has zero framework tests (everything assumed a
+real ssh cluster). Our strategy replaces that with in-process multi-device
+tests on a virtual CPU mesh — env vars must be set before jax initializes.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8"
+                           ).strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize may import jax and latch JAX_PLATFORMS
+# (e.g. to a real TPU backend) before this conftest runs, so override at
+# runtime rather than via env.
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _devices():
+    assert jax.device_count() == 8, (
+        f"expected 8 virtual CPU devices, got {jax.device_count()}")
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

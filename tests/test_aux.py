@@ -1,0 +1,125 @@
+"""Checkpoint, profiler, and partition-search-in-session tests."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import parallax_tpu as parallax
+from parallax_tpu.models import simple
+
+
+def _run_steps(sess, rng, n, bs=64):
+    out = None
+    for _ in range(n):
+        b = simple.make_batch(rng, bs)
+        out = sess.run(["loss", "global_step"], feed_dict=b)
+    return out
+
+
+class TestCheckpoint:
+    def test_save_and_restore_resumes_step(self, tmp_path, rng):
+        ckpt_dir = str(tmp_path / "ckpt")
+        cfg = parallax.Config(
+            run_option="AR", search_partitions=False,
+            ckpt_config=parallax.CheckPointConfig(ckpt_dir=ckpt_dir,
+                                                  save_ckpt_steps=5))
+        model = simple.build_model(0.1)
+        sess, *_ = parallax.parallel_run(model, parallax_config=cfg)
+        loss1, step1 = _run_steps(sess, rng, 12)
+        w_before = np.asarray(sess.state.params["w"])
+        sess.close()
+        assert step1 == 12
+
+        # New session restores the latest checkpoint (step 10) like
+        # MonitoredTrainingSession restore-from-checkpoint_dir.
+        sess2, *_ = parallax.parallel_run(simple.build_model(0.1),
+                                          parallax_config=cfg)
+        _, step2 = _run_steps(sess2, rng, 1)
+        assert step2 == 11  # resumed from 10
+        sess2.close()
+
+    def test_save_every_n_steps(self, tmp_path, rng):
+        ckpt_dir = str(tmp_path / "ckpt2")
+        cfg = parallax.Config(
+            run_option="AR", search_partitions=False,
+            ckpt_config=parallax.CheckPointConfig(ckpt_dir=ckpt_dir,
+                                                  save_ckpt_steps=3))
+        sess, *_ = parallax.parallel_run(simple.build_model(0.1),
+                                         parallax_config=cfg)
+        _run_steps(sess, rng, 7)
+        sess.close()
+        steps = sorted(int(os.path.basename(p)) for p in
+                       glob.glob(os.path.join(ckpt_dir, "*"))
+                       if os.path.basename(p).isdigit())
+        assert steps == [3, 6]
+
+
+class TestProfiler:
+    def test_profile_steps_write_trace(self, tmp_path, rng):
+        prof_dir = str(tmp_path / "prof")
+        cfg = parallax.Config(
+            run_option="AR", search_partitions=False,
+            profile_config=parallax.ProfileConfig(profile_dir=prof_dir,
+                                                  profile_steps=[2]))
+        sess, *_ = parallax.parallel_run(simple.build_model(0.1),
+                                         parallax_config=cfg)
+        _run_steps(sess, rng, 4)
+        sess.close()
+        traces = glob.glob(os.path.join(prof_dir, "**", "*.xplane.pb"),
+                           recursive=True)
+        assert traces, f"no xplane trace written under {prof_dir}"
+
+    def test_profile_worker_gating(self, tmp_path, rng):
+        prof_dir = str(tmp_path / "prof2")
+        cfg = parallax.Config(
+            run_option="AR", search_partitions=False,
+            profile_config=parallax.ProfileConfig(profile_dir=prof_dir,
+                                                  profile_steps=[1],
+                                                  profile_worker=3))
+        sess, *_ = parallax.parallel_run(simple.build_model(0.1),
+                                         parallax_config=cfg)
+        _run_steps(sess, rng, 3)
+        sess.close()
+        assert not os.path.exists(prof_dir)  # we are worker 0, not 3
+
+
+class TestPartitionSearchInSession:
+    def test_search_replans_live(self, rng, monkeypatch):
+        """Partition search rebuilds the engine in place (the reference
+        kills and relaunches the cluster, partitions.py:74-138)."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from parallax_tpu.common import consts as c
+        from parallax_tpu.core import mesh as mesh_lib
+        from parallax_tpu.ops import embedding as emb_ops
+
+        # shrink the timing window so the test is fast
+        monkeypatch.setattr(c, "NUM_ITERATIONS_FOR_WARMUP", 1)
+        monkeypatch.setattr(c, "NUM_ITERATIONS_FOR_TEST", 3)
+        monkeypatch.setenv(c.PARALLAX_MIN_PARTITIONS, "1")
+
+        V, D = 32, 8
+
+        def init_fn(rng_):
+            return {"emb": jax.random.normal(rng_, (V, D)) * 0.1}
+
+        def loss_fn(params, batch):
+            rows = emb_ops.embedding_lookup(params["emb"], batch["ids"])
+            return jnp.mean(rows ** 2)
+
+        model = parallax.Model(init_fn, loss_fn, optimizer=optax.sgd(0.1))
+        sess, *_ = parallax.parallel_run(
+            model, parallax_config=parallax.Config(run_option="HYBRID"))
+        seen_p = set()
+        for _ in range(40):
+            sess.run("loss", feed_dict={
+                "ids": rng.integers(0, V, (16,)).astype(np.int32)})
+            seen_p.add(mesh_lib.num_shards(sess.engine.mesh))
+            if sess._search is None:
+                break
+        assert sess._search is None, "search did not converge"
+        assert len(seen_p) >= 2, f"search never changed p: {seen_p}"
+        sess.close()

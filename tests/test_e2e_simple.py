@@ -1,0 +1,76 @@
+"""End-to-end: parallel_run on the simple linear-regression model.
+
+Parity target: the reference's de-facto smoke test
+(examples/simple/simple_driver.py:93-136) — converging loss, session
+feed/fetch contract, per-replica feed lists.
+"""
+
+import numpy as np
+import pytest
+
+import parallax_tpu as parallax
+from parallax_tpu.models import simple
+
+
+@pytest.fixture
+def session():
+    model = simple.build_model(learning_rate=0.1)
+    sess, num_workers, worker_id, num_replicas = parallax.parallel_run(
+        model, resource_info=None, sync=True,
+        parallax_config=parallax.Config(run_option="AR",
+                                        search_partitions=False))
+    assert num_workers == 1
+    assert worker_id == 0
+    assert num_replicas == 8
+    yield sess, num_replicas
+    sess.close()
+
+
+def test_converges_and_fetch_contract(session, rng):
+    sess, _ = session
+    losses = []
+    for _ in range(60):
+        batch = simple.make_batch(rng, 64)
+        loss, step = sess.run(["loss", "global_step"],
+                              feed_dict={"x": batch["x"], "y": batch["y"]})
+        losses.append(loss)
+    assert step == 60
+    assert losses[-1] < losses[0] * 0.1
+    # learned w ~ 10, b ~ -5 (reference's ground truth)
+    out = sess.run(None, feed_dict={"x": batch["x"], "y": batch["y"]})
+    assert abs(out["w"] - 10.0) < 1.0
+    assert abs(out["b"] + 5.0) < 1.0
+
+
+def test_per_replica_feed_lists(session, rng):
+    """Reference contract (session_context.py:205-233): feeds may be lists
+    of num_replicas_per_worker arrays."""
+    sess, num_replicas = session
+    per_replica = [simple.make_batch(rng, 8) for _ in range(num_replicas)]
+    loss = sess.run("loss", feed_dict={
+        "x": [b["x"] for b in per_replica],
+        "y": [b["y"] for b in per_replica]})
+    assert np.isfinite(loss)
+
+
+def test_wrong_replica_list_length_raises(session, rng):
+    sess, _ = session
+    with pytest.raises(ValueError, match="num_replicas_per_worker"):
+        sess.run("loss", feed_dict={"x": [np.zeros(4)] * 3,
+                                    "y": [np.zeros(4)] * 3})
+
+
+def test_unknown_fetch_raises(session, rng):
+    sess, _ = session
+    batch = simple.make_batch(rng, 64)
+    sess.run(None, feed_dict=batch)
+    with pytest.raises(KeyError, match="nope"):
+        sess.run("nope", feed_dict=batch)
+
+
+def test_state_is_replicated_on_mesh(session, rng):
+    sess, _ = session
+    batch = simple.make_batch(rng, 64)
+    sess.run(None, feed_dict=batch)
+    w = sess.state.params["w"]
+    assert w.sharding.is_fully_replicated
