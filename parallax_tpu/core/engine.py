@@ -265,8 +265,15 @@ class Engine:
     def shard_batch(self, batch):
         """Place a host batch onto the mesh, sharded on dim 0 (the
         reference's per-replica feed splitting, session_context.py:205-233)."""
+        n = mesh_lib.num_devices(self.mesh)
+
         def put(x):
             x = np.asarray(x)
+            if x.ndim >= 1 and x.shape[0] % n != 0:
+                raise ValueError(
+                    f"batch dimension {x.shape[0]} is not divisible by the "
+                    f"{n} devices of the mesh; pad the global batch (or "
+                    f"feed per-replica lists of equal size)")
             return jax.device_put(x, self.batch_sharding_fn(x.ndim))
         return jax.tree.map(put, batch)
 

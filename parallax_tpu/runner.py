@@ -42,8 +42,13 @@ def parallel_run(model: Model,
                  resource_info: Optional[str] = None,
                  sync: bool = True,
                  parallax_config: Optional[ParallaxConfig] = None,
-                 seed: int = 0
+                 seed: int = 0,
+                 num_partitions: Optional[int] = None
                  ) -> Tuple[ParallaxSession, int, int, int]:
+    """``num_partitions`` pins the shard-axis size (the reference's
+    embedding partition count); env PARALLAX_PARTITIONS overrides it, and
+    leaving both unset enables the auto-search when
+    PARALLAX_MIN_PARTITIONS is set."""
     config = parallax_config or ParallaxConfig()
     config.set_sync(sync)
 
@@ -75,10 +80,11 @@ def parallel_run(model: Model,
     shard_lib._install(num_workers, worker_id)
 
     search = None
-    num_partitions = None
     min_p = os.environ.get(consts.PARALLAX_MIN_PARTITIONS)
     if os.environ.get(consts.PARALLAX_PARTITIONS):
         num_partitions = get_partitioner()
+    elif num_partitions is not None:
+        pass  # explicit argument wins over auto-search
     elif config.search_partitions and min_p:
         search = PartitionSearch(int(min_p), jax.device_count())
         num_partitions = search.first_candidate()
