@@ -1,0 +1,61 @@
+"""CNN benchmark driver.
+
+Parity with the reference's benchmark driver
+(reference: examples/tf_cnn_benchmarks/CNNBenchmark_distributed_driver.py
+:50-91): pick a model by name, train on synthetic or real data through
+parallel_run, log steps/sec (and images/sec).
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import parallax_tpu as parallax
+from parallax_tpu.models import cnn
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50_v1.5",
+                    choices=sorted(cnn.MODEL_REGISTRY))
+    ap.add_argument("--resource_info", default=None)
+    ap.add_argument("--batch_size", type=int, default=256,
+                    help="global batch size")
+    ap.add_argument("--image_size", type=int, default=None)
+    ap.add_argument("--num_classes", type=int, default=1000)
+    ap.add_argument("--max_steps", type=int, default=100)
+    ap.add_argument("--log_frequency", type=int, default=10)
+    ap.add_argument("--run_option", default="HYBRID")
+    args = ap.parse_args()
+
+    size = args.image_size or cnn.default_image_size(args.model)
+    model = cnn.build_model(args.model, num_classes=args.num_classes,
+                            image_size=size)
+    sess, num_workers, worker_id, num_replicas = parallax.parallel_run(
+        model, args.resource_info,
+        parallax_config=parallax.Config(run_option=args.run_option,
+                                        search_partitions=False))
+    print(f"model={args.model} image={size} workers={num_workers} "
+          f"replicas={num_replicas}")
+
+    rng = np.random.default_rng(worker_id)
+    batches = [cnn.make_batch(rng, args.batch_size, size,
+                              args.num_classes) for _ in range(4)]
+    t_last, steps_done = time.perf_counter(), 0
+    for i in range(args.max_steps):
+        loss, acc, step = sess.run(["loss", "accuracy", "global_step"],
+                                   feed_dict=batches[i % 4])
+        steps_done += 1
+        if step % args.log_frequency == 0:
+            now = time.perf_counter()
+            sps = steps_done / (now - t_last)
+            t_last, steps_done = now, 0
+            print(f"step {step}: loss {loss:.4f} acc {acc:.3f}  "
+                  f"{sps:.2f} steps/sec ({sps * args.batch_size:,.0f} "
+                  f"images/sec)")
+    sess.close()
+
+
+if __name__ == "__main__":
+    main()

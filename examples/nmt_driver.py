@@ -1,0 +1,59 @@
+"""NMT driver (reference: examples/nmt/nmt_distributed_driver.py).
+
+Transformer seq2seq with the shared embedding on the sparse path;
+synthetic parallel corpus unless --data_path provides token streams.
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import parallax_tpu as parallax
+from parallax_tpu.models import nmt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--resource_info", default=None)
+    ap.add_argument("--vocab_size", type=int, default=32000)
+    ap.add_argument("--model_dim", type=int, default=512)
+    ap.add_argument("--num_layers", type=int, default=6)
+    ap.add_argument("--batch_size", type=int, default=64)
+    ap.add_argument("--src_len", type=int, default=64)
+    ap.add_argument("--tgt_len", type=int, default=64)
+    ap.add_argument("--max_steps", type=int, default=100)
+    ap.add_argument("--log_frequency", type=int, default=10)
+    ap.add_argument("--run_option", default="HYBRID")
+    ap.add_argument("--partitions", type=int, default=None)
+    args = ap.parse_args()
+
+    num_partitions = parallax.get_partitioner(args.partitions)
+    cfg = nmt.NMTConfig(vocab_size=args.vocab_size,
+                        model_dim=args.model_dim,
+                        num_layers=args.num_layers,
+                        max_len=max(args.src_len, args.tgt_len),
+                        num_partitions=num_partitions)
+    sess, num_workers, worker_id, _ = parallax.parallel_run(
+        nmt.build_model(cfg), args.resource_info,
+        parallax_config=parallax.Config(run_option=args.run_option),
+        num_partitions=num_partitions)
+
+    rng = np.random.default_rng(worker_id)
+    words, t_last = 0.0, time.perf_counter()
+    for i in range(args.max_steps):
+        batch = nmt.make_batch(rng, args.batch_size, args.src_len,
+                               args.tgt_len, cfg.vocab_size)
+        loss, w, step = sess.run(["loss", "words", "global_step"],
+                                 feed_dict=batch)
+        words += w
+        if step % args.log_frequency == 0:
+            now = time.perf_counter()
+            print(f"step {step}: loss {loss:.4f}  "
+                  f"{words / (now - t_last):,.0f} target words/sec")
+            words, t_last = 0.0, now
+    sess.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -53,9 +53,15 @@ class Model:
     """A single-device model description — the unit the user hands to
     `parallel_run`, replacing the reference's single-GPU tf.Graph.
 
-    * ``init_fn(rng) -> params`` — parameter pytree initializer.
+    * ``init_fn(rng) -> params`` — parameter pytree initializer. For a
+      *stateful* model (``stateful=True``, e.g. BatchNorm statistics) it
+      returns ``(params, model_state)``; only ``params`` gets gradients.
     * ``loss_fn(params, batch[, rng]) -> loss | (loss, metrics_dict)`` —
-      pure forward+loss on one logical batch.
+      pure forward+loss on one logical batch. Stateful models take
+      ``loss_fn(params, model_state, batch, rng)`` and return
+      ``(loss, metrics, new_model_state)`` — the SPMD analogue of TF's
+      UPDATE_OPS: statistics reduce over the *global* batch because the
+      whole step is one jitted program over the mesh.
     * ``optimizer`` — an optax GradientTransformation (default: sgd(0.01)).
     * ``sparse_params`` / ``dense_params`` — path-string overrides for the
       automatic classifier (classify.py).
@@ -64,30 +70,48 @@ class Model:
     def __init__(self, init_fn: Callable, loss_fn: Callable,
                  optimizer: Optional[optax.GradientTransformation] = None,
                  sparse_params: Sequence[str] = (),
-                 dense_params: Sequence[str] = ()):
+                 dense_params: Sequence[str] = (),
+                 stateful: bool = False):
         self.init_fn = init_fn
         self.loss_fn = loss_fn
         self.optimizer = optimizer or optax.sgd(0.01)
         self.sparse_params = tuple(sparse_params)
         self.dense_params = tuple(dense_params)
+        self.stateful = stateful
         try:
             n_pos = len([
                 p for p in inspect.signature(loss_fn).parameters.values()
                 if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)])
         except (TypeError, ValueError):
-            n_pos = 2
-        self._loss_takes_rng = n_pos >= 3
+            n_pos = 4 if stateful else 2
+        self._loss_takes_rng = n_pos >= (4 if stateful else 3)
 
-    def call_loss(self, params, batch, rng):
-        if self._loss_takes_rng:
-            out = self.loss_fn(params, batch, rng)
+    def call_init(self, rng):
+        """Returns (params, model_state); model_state is None for
+        stateless models."""
+        out = self.init_fn(rng)
+        if self.stateful:
+            return out
+        return out, None
+
+    def call_loss(self, params, batch, rng, model_state=None):
+        """Returns (loss, metrics, new_model_state)."""
+        if self.stateful:
+            args = (params, model_state, batch)
         else:
-            out = self.loss_fn(params, batch)
+            args = (params, batch)
+        if self._loss_takes_rng:
+            out = self.loss_fn(*args, rng)
+        else:
+            out = self.loss_fn(*args)
+        if self.stateful:
+            loss, metrics, new_state = out
+            return loss, dict(metrics), new_state
         if isinstance(out, tuple):
             loss, metrics = out
         else:
             loss, metrics = out, {}
-        return loss, dict(metrics)
+        return loss, dict(metrics), None
 
 
 @struct.dataclass
@@ -96,6 +120,7 @@ class TrainState:
     params: Any
     opt_state: Any
     rng: jax.Array
+    model_state: Any = None  # non-trainable state (e.g. BatchNorm stats)
 
 
 @dataclasses.dataclass
@@ -113,16 +138,18 @@ class ShardingPlan:
 
 
 def build_plan(model: Model, mesh: Mesh, config: ParallaxConfig,
-               params_shapes, example_batch) -> ShardingPlan:
+               params_shapes, example_batch,
+               model_state_shapes=None) -> ShardingPlan:
     """Classify variables and choose PartitionSpecs (the 'graph transform')."""
     p = mesh_lib.num_shards(mesh)
 
-    def abstract_loss(params, batch, rng):
-        return model.call_loss(params, batch, rng)[0]
+    def abstract_loss(params, batch, rng, mstate):
+        return model.call_loss(params, batch, rng, mstate)[0]
 
     rng_shape = jax.ShapeDtypeStruct((2,), jnp.uint32)
     var_specs = classify.classify_params(
         abstract_loss, params_shapes, example_batch, rng_shape,
+        model_state_shapes,
         sparse_override=model.sparse_params,
         dense_override=model.dense_params)
 
@@ -192,12 +219,12 @@ class Engine:
                 "synchronous; running synchronously (the async-PS staleness "
                 "model does not exist under SPMD).")
         rng = jax.random.PRNGKey(0)
-        params_shapes = jax.eval_shape(model.init_fn, rng)
+        params_shapes, mstate_shapes = jax.eval_shape(model.call_init, rng)
         batch_shapes = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(np.shape(x), _dtype_of(x)),
             example_batch)
         self.plan = build_plan(model, mesh, config, params_shapes,
-                               batch_shapes)
+                               batch_shapes, mstate_shapes)
         self._param_shardings = jax.tree.map(
             lambda spec: NamedSharding(mesh, spec), self.plan.param_pspecs,
             is_leaf=lambda x: isinstance(x, P))
@@ -215,13 +242,14 @@ class Engine:
 
         def init_state(seed: jax.Array) -> TrainState:
             rng = jax.random.PRNGKey(seed)
-            params = model.init_fn(rng)
+            params, mstate = model.call_init(rng)
             params = jax.lax.with_sharding_constraint(params,
                                                       param_shardings)
             opt_state = model.optimizer.init(params)
             return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                               opt_state=opt_state,
-                              rng=jax.random.PRNGKey(seed + 1))
+                              rng=jax.random.PRNGKey(seed + 1),
+                              model_state=mstate)
 
         def train_step(state: TrainState, batch):
             step_rng = jax.random.fold_in(state.rng, state.step)
@@ -229,9 +257,11 @@ class Engine:
             def loss_wrap(params):
                 with embedding.sharded_lookup_scope(mesh, sharded_shapes,
                                                     avg):
-                    return model.call_loss(params, batch, step_rng)
+                    loss, metrics, new_mstate = model.call_loss(
+                        params, batch, step_rng, state.model_state)
+                return loss, (metrics, new_mstate)
 
-            (loss, metrics), grads = jax.value_and_grad(
+            (loss, (metrics, new_mstate)), grads = jax.value_and_grad(
                 loss_wrap, has_aux=True)(state.params)
             updates, opt_state = model.optimizer.update(
                 grads, state.opt_state, state.params)
@@ -239,7 +269,8 @@ class Engine:
             params = jax.lax.with_sharding_constraint(params,
                                                       param_shardings)
             new_state = state.replace(step=state.step + 1, params=params,
-                                      opt_state=opt_state)
+                                      opt_state=opt_state,
+                                      model_state=new_mstate)
             outputs = {"loss": loss, "global_step": new_state.step}
             outputs.update(metrics)
             return new_state, outputs

@@ -54,8 +54,8 @@ class LM1BConfig:
 
     @property
     def padded_vocab(self) -> int:
-        p = self.num_partitions or jax.device_count()
-        return emb_ops.pad_vocab(self.vocab_size, max(p, 1))
+        return emb_ops.padded_vocab_for(self.vocab_size,
+                                        self.num_partitions)
 
 
 def tiny_config(**kw) -> LM1BConfig:

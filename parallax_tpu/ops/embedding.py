@@ -74,6 +74,25 @@ def pad_vocab(vocab_size: int, multiple: int) -> int:
     return -(-vocab_size // multiple) * multiple
 
 
+def padded_vocab_for(vocab_size: int, num_partitions: Optional[int]) -> int:
+    """Shared padding policy for model configs: pad so the table splits
+    evenly over ``num_partitions`` (default: every visible device)."""
+    p = num_partitions or jax.device_count()
+    return pad_vocab(vocab_size, max(p, 1))
+
+
+def mask_padded_logits(logits: jax.Array, vocab_size: int) -> jax.Array:
+    """-inf the phantom classes introduced by vocab padding so they never
+    receive probability mass (last-dim layout [..., padded_vocab])."""
+    padded = logits.shape[-1]
+    if padded == vocab_size:
+        return logits
+    mask = jnp.concatenate(
+        [jnp.zeros((vocab_size,), logits.dtype),
+         jnp.full((padded - vocab_size,), -1e9, logits.dtype)])
+    return logits + mask
+
+
 def embedding_lookup(table: jax.Array, ids: jax.Array,
                      sharded: Optional[bool] = None) -> jax.Array:
     """Look up rows of ``table`` (shape [V, D]) at integer ``ids``.

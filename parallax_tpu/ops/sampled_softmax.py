@@ -100,11 +100,8 @@ def full_softmax_loss(softmax_w, softmax_b, hidden, labels,
     """Exact softmax loss (eval path; reference lm1b_eval.py).
     ``softmax_b`` is the [V, 1] column vector used by the train path."""
     logits = hidden @ softmax_w.T + softmax_b[:, 0][None, :]
-    if vocab_size is not None and vocab_size < softmax_w.shape[0]:
-        pad = softmax_w.shape[0] - vocab_size
-        mask = jnp.concatenate([jnp.zeros((vocab_size,)),
-                                jnp.full((pad,), -1e9)])
-        logits = logits + mask[None, :]
+    if vocab_size is not None:
+        logits = emb_ops.mask_padded_logits(logits, vocab_size)
     lse = jax.nn.logsumexp(logits, axis=1)
     true_logit = jnp.take_along_axis(logits, labels[:, None], axis=1)[:, 0]
     return lse - true_logit

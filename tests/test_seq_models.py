@@ -1,0 +1,72 @@
+"""NMT transformer + skip-thoughts model tests."""
+
+import jax
+import numpy as np
+import pytest
+
+import parallax_tpu as parallax
+from parallax_tpu.models import nmt, skip_thoughts
+
+
+class TestNMT:
+    def test_shared_embedding_sparse_out_proj_dense(self, rng):
+        cfg = nmt.tiny_config(num_partitions=8)
+        model = nmt.build_model(cfg)
+        sess, *_ = parallax.parallel_run(
+            model, parallax_config=parallax.Config(run_option="HYBRID",
+                                                   search_partitions=False))
+        batch = nmt.make_batch(rng, 16, 8, 8, cfg.vocab_size)
+        sess.run(None, feed_dict=batch)
+        specs = sess.engine.plan.var_specs
+        assert specs["emb"].is_sparse           # shared gather-only table
+        assert not specs["out_proj"].is_sparse  # used densely
+        assert not sess.state.params["emb"].sharding.is_fully_replicated
+        sess.close()
+
+    def test_training_reduces_loss(self, rng):
+        cfg = nmt.tiny_config(num_partitions=8, learning_rate=3e-3,
+                              warmup_steps=10)
+        model = nmt.build_model(cfg)
+        sess, *_ = parallax.parallel_run(
+            model, parallax_config=parallax.Config(run_option="HYBRID",
+                                                   search_partitions=False))
+        batches = [nmt.make_batch(rng, 16, 8, 8, cfg.vocab_size)
+                   for _ in range(2)]
+        losses = [sess.run("loss", feed_dict=batches[i % 2])
+                  for i in range(60)]
+        assert losses[-1] < losses[0] * 0.85, (losses[0], losses[-1])
+        assert np.isfinite(losses[-1])
+        sess.close()
+
+    def test_padding_tokens_masked_out(self, rng):
+        """Target weight defaults mask label 0 (padding)."""
+        cfg = nmt.tiny_config(num_partitions=8)
+        model = nmt.build_model(cfg)
+        sess, *_ = parallax.parallel_run(
+            model, parallax_config=parallax.Config(run_option="HYBRID",
+                                                   search_partitions=False))
+        batch = nmt.make_batch(rng, 16, 8, 8, cfg.vocab_size)
+        batch["tgt_out"][:, -4:] = 0  # pad half the targets
+        out = sess.run(None, feed_dict=batch)
+        assert out["words"] == 16 * 8 - 16 * 4
+        sess.close()
+
+
+class TestSkipThoughts:
+    def test_classification_and_training(self, rng):
+        cfg = skip_thoughts.tiny_config(num_partitions=8,
+                                        learning_rate=3e-3)
+        model = skip_thoughts.build_model(cfg)
+        sess, *_ = parallax.parallel_run(
+            model, parallax_config=parallax.Config(run_option="HYBRID",
+                                                   search_partitions=False))
+        batches = [skip_thoughts.make_batch(rng, 16, 6, cfg.vocab_size)
+                   for _ in range(2)]
+        first = sess.run("loss", feed_dict=batches[0])
+        specs = sess.engine.plan.var_specs
+        assert specs["emb"].is_sparse
+        assert not specs["out_w"].is_sparse
+        for i in range(50):
+            last = sess.run("loss", feed_dict=batches[i % 2])
+        assert last < first * 0.9, (first, last)
+        sess.close()
