@@ -32,6 +32,9 @@ def main():
     ap.add_argument("--max_steps", type=int, default=100)
     ap.add_argument("--log_frequency", type=int, default=10)
     ap.add_argument("--run_option", default="HYBRID")
+    ap.add_argument("--data_path", default=None,
+                    help="int32 token file (parallax_tpu.data format); "
+                         "default: synthetic Zipf stream")
     ap.add_argument("--partitions", type=int, default=None,
                     help="embedding partitions (reference "
                          "get_partitioner(32)); default auto")
@@ -50,11 +53,22 @@ def main():
     print(f"workers={num_workers} replicas={num_replicas} "
           f"padded_vocab={cfg.padded_vocab}")
 
+    dataset = None
+    if args.data_path:
+        from parallax_tpu.data import TokenDataset
+        dataset = TokenDataset(args.data_path, args.batch_size,
+                               args.num_steps,
+                               num_shards=num_workers,
+                               shard_id=worker_id)
+        print(f"data: {dataset.num_tokens:,} tokens "
+              f"({dataset.backend} backend)")
+
     rng = np.random.default_rng(worker_id)
     words_acc, t_last = 0.0, time.perf_counter()
     for i in range(args.max_steps):
-        batch = lm1b.make_batch(rng, args.batch_size, args.num_steps,
-                                cfg.vocab_size)
+        batch = (dataset.next_batch() if dataset
+                 else lm1b.make_batch(rng, args.batch_size,
+                                      args.num_steps, cfg.vocab_size))
         loss, words, step = sess.run(["loss", "words", "global_step"],
                                      feed_dict=batch)
         words_acc += words

@@ -71,13 +71,17 @@ class Model:
                  optimizer: Optional[optax.GradientTransformation] = None,
                  sparse_params: Sequence[str] = (),
                  dense_params: Sequence[str] = (),
-                 stateful: bool = False):
+                 stateful: bool = False,
+                 batch_specs: Optional[Dict[str, Any]] = None):
         self.init_fn = init_fn
         self.loss_fn = loss_fn
         self.optimizer = optimizer or optax.sgd(0.01)
         self.sparse_params = tuple(sparse_params)
         self.dense_params = tuple(dense_params)
         self.stateful = stateful
+        # feed name -> PartitionSpec override (e.g. sequence-parallel
+        # inputs sharded P('repl', 'shard') on [batch, seq])
+        self.batch_specs = dict(batch_specs or {})
         try:
             n_pos = len([
                 p for p in inspect.signature(loss_fn).parameters.values()
@@ -294,19 +298,29 @@ class Engine:
         return new_state, outputs
 
     def shard_batch(self, batch):
-        """Place a host batch onto the mesh, sharded on dim 0 (the
-        reference's per-replica feed splitting, session_context.py:205-233)."""
+        """Place a host batch onto the mesh, sharded on dim 0 by default
+        (the reference's per-replica feed splitting,
+        session_context.py:205-233); Model.batch_specs overrides the
+        layout per feed name (e.g. sequence-parallel inputs)."""
         n = mesh_lib.num_devices(self.mesh)
+        overrides = self.model.batch_specs
 
-        def put(x):
+        def put(name, x):
             x = np.asarray(x)
+            if name in overrides:
+                return jax.device_put(
+                    x, NamedSharding(self.mesh, overrides[name]))
             if x.ndim >= 1 and x.shape[0] % n != 0:
                 raise ValueError(
                     f"batch dimension {x.shape[0]} is not divisible by the "
                     f"{n} devices of the mesh; pad the global batch (or "
                     f"feed per-replica lists of equal size)")
             return jax.device_put(x, self.batch_sharding_fn(x.ndim))
-        return jax.tree.map(put, batch)
+
+        if isinstance(batch, dict):
+            return {k: jax.tree.map(lambda x, k=k: put(k, x), v)
+                    for k, v in batch.items()}
+        return jax.tree.map(lambda x: put("", x), batch)
 
     def _export_graph(self, state, batch):
         """Dump compiled-step HLO text (reference: export_graph_path dumps

@@ -1,0 +1,177 @@
+"""Token-stream dataset with native prefetch.
+
+The python face of the C++ loader (cpp/dataloader.cc): mmap'd int32 token
+files, background prefetch, mod-filter sharding identical to the shard
+API (reference shard.py:69-87 semantics at the window level). Falls back
+to a pure-numpy implementation with the same observable behavior when
+the native library can't be built (no toolchain).
+
+The native library is built on demand with g++ next to the module and
+cached; set PARALLAX_DATA_BACKEND=numpy to force the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Iterator, Optional
+
+import numpy as np
+
+from parallax_tpu.common.lib import parallax_log
+
+_SO_NAME = "libparallax_data.so"
+_lib = None
+_lib_tried = False
+
+
+def _native_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("PARALLAX_DATA_BACKEND") == "numpy":
+        return None
+    here = os.path.dirname(__file__)
+    so_path = os.path.join(here, _SO_NAME)
+    src = os.path.join(here, "cpp", "dataloader.cc")
+    if not os.path.exists(src):
+        # prebuilt-only deployment: use the .so if present, else fall back
+        if not os.path.exists(so_path):
+            return None
+    elif (not os.path.exists(so_path)
+          or os.path.getmtime(so_path) < os.path.getmtime(src)):
+        try:
+            subprocess.check_call(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 "-pthread", "-o", so_path, src],
+                stderr=subprocess.DEVNULL)
+        except (OSError, subprocess.CalledProcessError) as e:
+            parallax_log.warning(
+                "native dataloader build failed (%s); using numpy "
+                "fallback", e)
+            return None
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError as e:
+        parallax_log.warning("native dataloader load failed (%s)", e)
+        return None
+    lib.pl_open.restype = ctypes.c_void_p
+    lib.pl_open.argtypes = [ctypes.c_char_p]
+    lib.pl_num_tokens.restype = ctypes.c_long
+    lib.pl_num_tokens.argtypes = [ctypes.c_void_p]
+    lib.pl_start.restype = ctypes.c_int
+    lib.pl_start.argtypes = [ctypes.c_void_p] + [ctypes.c_long] * 6
+    lib.pl_next.restype = ctypes.c_int
+    lib.pl_next.argtypes = [ctypes.c_void_p,
+                            ctypes.POINTER(ctypes.c_int32)]
+    lib.pl_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> None:
+    """Serialize an int32 token stream in the loader's format."""
+    np.asarray(tokens, dtype=np.int32).tofile(path)
+
+
+class TokenDataset:
+    """Fixed-window LM batches from a token file.
+
+    Yields {"x": [B, T], "y": [B, T], "w": [B, T]} — the LM1B driver feed
+    contract (x = window[:-1], y = window[1:], w = ones).
+    """
+
+    def __init__(self, path: str, batch_size: int, num_steps: int,
+                 num_shards: int = 1, shard_id: int = 0, seed: int = 0,
+                 queue_depth: int = 4):
+        self.path = path
+        self.batch_size = batch_size
+        self.num_steps = num_steps
+        self.num_shards = num_shards
+        self.shard_id = shard_id
+        self.seed = seed
+        self._window = num_steps + 1
+        self._handle = None
+        self._epoch = 0
+        lib = _native_lib()
+        if lib is not None:
+            handle = lib.pl_open(path.encode())
+            if handle:
+                rc = lib.pl_start(handle, batch_size, num_steps,
+                                  num_shards, shard_id, seed, queue_depth)
+                if rc == 0:
+                    self._handle = handle
+                    self._lib = lib
+                    self.backend = "native"
+                    return
+                lib.pl_close(handle)
+                if rc == -2:
+                    raise ValueError(
+                        f"{path}: not enough tokens for one "
+                        f"[{batch_size} x {num_steps + 1}] batch on shard "
+                        f"{shard_id}/{num_shards}")
+        # numpy fallback (identical semantics)
+        self.backend = "numpy"
+        self._tokens = np.fromfile(path, dtype=np.int32)
+        n_windows = len(self._tokens) // self._window
+        self._mine = np.arange(shard_id, n_windows, num_shards)
+        if len(self._mine) < batch_size:
+            raise ValueError(
+                f"{path}: not enough tokens for one "
+                f"[{batch_size} x {num_steps + 1}] batch on shard "
+                f"{shard_id}/{num_shards}")
+        self._order = None
+        self._off = 0
+
+    @property
+    def num_tokens(self) -> int:
+        if self._handle is not None:
+            return self._lib.pl_num_tokens(self._handle)
+        return len(self._tokens)
+
+    def next_batch(self):
+        B, W = self.batch_size, self._window
+        if self._handle is not None:
+            buf = np.empty((B, W), np.int32)
+            epoch = self._lib.pl_next(
+                self._handle,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            if epoch < 0:
+                raise RuntimeError("native loader stopped")
+            self._epoch = epoch
+            windows = buf
+        else:
+            if self._order is None or self._off + B > len(self._order):
+                if self._order is not None:
+                    self._epoch += 1
+                prng = np.random.default_rng(
+                    self.seed * 1000003 + self._epoch)
+                self._order = prng.permutation(self._mine)
+                self._off = 0
+            idx = self._order[self._off:self._off + B]
+            self._off += B
+            windows = np.stack(
+                [self._tokens[w * W:(w + 1) * W] for w in idx])
+        return {"x": windows[:, :-1], "y": windows[:, 1:],
+                "w": np.ones((B, W - 1), np.float32)}
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            yield self.next_batch()
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.pl_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

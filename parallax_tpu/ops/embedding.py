@@ -68,6 +68,15 @@ def sharded_lookup_scope(mesh: Mesh, sharded_shapes,
         _CTX.reset(token)
 
 
+def current_mesh() -> Optional[Mesh]:
+    """The mesh installed by the engine for the current trace (None when
+    tracing outside parallel_run, e.g. single-device reference runs).
+    Lets model code reach collectives-aware ops (ring_attention) without
+    threading the mesh through every signature."""
+    ctx = _CTX.get()
+    return ctx.mesh if ctx is not None else None
+
+
 def pad_vocab(vocab_size: int, multiple: int) -> int:
     """Round vocab up so rows split evenly over shards (XLA wants even
     splits; the reference's fixed_size_partitioner tolerated ragged ones)."""

@@ -1,0 +1,130 @@
+"""Ring attention — sequence/context parallelism over an ICI ring.
+
+The reference has no sequence parallelism (SURVEY.md §5.7: LSTM-era
+models, sequence length is a plain hyperparameter). For the TPU rebuild
+long-context is first-class: attention over sequences sharded across a
+mesh axis, with K/V blocks rotated around the ring via `ppermute` while
+each device accumulates its queries' attention online (flash-attention
+style running max/denominator), so no device ever materializes the full
+sequence or the full [T, T] score matrix.
+
+Per ring step each device holds one K/V block and overlaps compute with
+the neighbor exchange; communication per device per step is the K/V block
+(2 · B · T/n · H · D), independent of the number of devices — the
+all-to-all sequence-parallel cost model.
+
+Differentiable: the ring loop is a `lax.scan` (static trip count =
+ring size), so reverse-mode AD threads the same ring backwards.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   mesh: Mesh, axis: str,
+                   causal: bool = False,
+                   scale: Optional[float] = None,
+                   batch_axis: Optional[str] = None) -> jax.Array:
+    """Attention with the sequence dimension sharded over ``axis``.
+
+    q, k, v: [B, T, H, D] with T sharded over ``axis`` (global views);
+    ``batch_axis`` optionally shards B over another mesh axis (dp x sp).
+    Returns [B, T, H, D] sharded the same way.
+    """
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    n = mesh.shape[axis]
+    spec = P(batch_axis, axis, None, None)
+
+    def local(q_loc, k_loc, v_loc):
+        # q_loc: [B, Tq, H, D] — this device's query block.
+        idx = jax.lax.axis_index(axis)
+        B, Tq, H, D = q_loc.shape
+        qh = (q_loc * scale).transpose(0, 2, 1, 3)        # [B, H, Tq, D]
+
+        # mark the accumulators as device-varying over every mesh axis the
+        # blocks vary over, so the scan carry type matches its output
+        # (they pick up per-device values).
+        vary = (axis,) if batch_axis is None else (axis, batch_axis)
+
+        def pvary(x):
+            return jax.lax.pcast(x, vary, to="varying")
+
+        m0 = pvary(jnp.full((B, H, Tq), _NEG_INF, jnp.float32))
+        l0 = pvary(jnp.zeros((B, H, Tq), jnp.float32))
+        o0 = pvary(jnp.zeros((B, H, Tq, D), jnp.float32))
+
+        def accumulate(k_blk, v_blk, s, m, l, o):
+            # Block s originated on device (idx - s) mod n.
+            kv_origin = (idx - s) % n
+            kh = k_blk.transpose(0, 2, 1, 3)              # [B, H, Tk, D]
+            vh = v_blk.transpose(0, 2, 1, 3)
+            scores = jnp.einsum(
+                "bhqd,bhkd->bhqk", qh, kh,
+                preferred_element_type=jnp.float32)       # [B,H,Tq,Tk]
+            if causal:
+                Tk = kh.shape[2]
+                q_pos = idx * Tq + jnp.arange(Tq)
+                k_pos = kv_origin * Tk + jnp.arange(Tk)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                scores = jnp.where(mask[None, None], scores, _NEG_INF)
+            m_new = jnp.maximum(m, scores.max(axis=-1))
+            alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+            p = jnp.exp(scores - m_new[..., None])
+            # fully-masked rows have scores == m_new == _NEG_INF, where
+            # exp(0) would leak mass — zero them explicitly
+            p = jnp.where(scores > _NEG_INF / 2, p, 0.0)
+            l = l * alpha + p.sum(axis=-1)
+            o = (o * alpha[..., None]
+                 + jnp.einsum("bhqk,bhkd->bhqd", p,
+                              vh.astype(jnp.float32)))
+            return m_new, l, o
+
+        def step(carry, s):
+            k_blk, v_blk, m, l, o = carry
+            m, l, o = accumulate(k_blk, v_blk, s, m, l, o)
+            # rotate the K/V block around the ring
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k_blk = jax.lax.ppermute(k_blk, axis, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis, perm)
+            return (k_blk, v_blk, m, l, o), None
+
+        # n-1 steps rotate; the last block is consumed without the (dead)
+        # final rotation, saving 2 collectives per layer per step.
+        (k_l, v_l, m, l, o), _ = jax.lax.scan(
+            step, (k_loc, v_loc, m0, l0, o0), jnp.arange(n - 1))
+        m, l, o = accumulate(k_l, v_l, n - 1, m, l, o)
+        denom = jnp.maximum(l, 1e-30)[..., None]
+        out = (o / denom).transpose(0, 2, 1, 3)           # [B, Tq, H, D]
+        return out.astype(q_loc.dtype)
+
+    return jax.shard_map(local, mesh=mesh,
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
+def full_attention_reference(q, k, v, causal=False, scale=None):
+    """Unsharded reference implementation (tests / single device)."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    qh = (q * scale).transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32)
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh.astype(jnp.float32))
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -1,0 +1,64 @@
+"""Sequence-parallel (ring attention) LM through the engine."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import parallax_tpu as parallax
+from parallax_tpu.models import long_context as lc
+
+
+def test_seq_parallel_training_matches_full_attention(rng):
+    """Same model, ring attention over the sp axis vs full attention on a
+    single logical device: identical loss trajectories."""
+    batches = [lc.make_batch(rng, 4, 32, 512) for _ in range(4)]
+
+    def run(use_ring, num_partitions):
+        cfg = lc.tiny_config(use_ring_attention=use_ring)
+        sess, *_ = parallax.parallel_run(
+            lc.build_model(cfg),
+            parallax_config=parallax.Config(run_option="HYBRID",
+                                            search_partitions=False),
+            num_partitions=num_partitions)
+        losses = [sess.run("loss", feed_dict=b) for b in batches]
+        sess.close()
+        return losses
+
+    # same mesh (repl=2, shard(seq)=4) both times; only the attention
+    # implementation differs (ring collectives vs one dense attention
+    # GSPMD reshards on its own)
+    ring = run(True, 4)
+    full = run(False, 4)
+    np.testing.assert_allclose(ring, full, rtol=2e-3)
+
+
+def test_activations_are_sequence_sharded(rng):
+    cfg = lc.tiny_config()
+    sess, *_ = parallax.parallel_run(
+        lc.build_model(cfg),
+        parallax_config=parallax.Config(run_option="HYBRID",
+                                        search_partitions=False),
+        num_partitions=4)
+    batch = lc.make_batch(rng, 4, 32, 512)
+    out = sess.run(None, feed_dict=batch)
+    assert out["tokens"] == 4 * 31
+    # input layout: [batch over repl, seq over shard]
+    placed = sess.engine.shard_batch(batch)
+    spec = placed["ids"].sharding.spec
+    assert tuple(spec) == ("repl", "shard")
+    sess.close()
+
+
+def test_long_sequence_runs(rng):
+    """A sequence 8x longer than one device's share executes fine."""
+    cfg = lc.tiny_config(max_len=256)
+    sess, *_ = parallax.parallel_run(
+        lc.build_model(cfg),
+        parallax_config=parallax.Config(run_option="HYBRID",
+                                        search_partitions=False),
+        num_partitions=8)
+    batch = lc.make_batch(rng, 8, 256, 512)
+    loss = sess.run("loss", feed_dict=batch)
+    assert np.isfinite(loss)
+    sess.close()
