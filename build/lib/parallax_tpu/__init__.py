@@ -1,0 +1,35 @@
+"""parallax_tpu — sparsity-aware automatic parallelization for TPU.
+
+A TPU-native framework with the capabilities of snuspl/parallax: hand it an
+unmodified single-device model and a resource spec; it classifies every
+variable as dense or sparse at trace time, replicates dense variables with
+all-reduced gradients over ICI, row-shards sparse embedding tables with
+all-to-all row exchange, and runs the whole thing as one compiled SPMD
+program over a `jax.sharding.Mesh`.
+
+Public API parity with the reference (parallax/__init__.py:16-26):
+get_partitioner, parallel_run, shard, log, Config, PSConfig, MPIConfig,
+CommunicationConfig, CheckPointConfig, ProfileConfig — plus the TPU-native
+additions `Model` (replaces the single-GPU tf.Graph as the unit handed to
+parallel_run) and the `ops` / `models` subpackages.
+"""
+
+from parallax_tpu.common.config import (CheckPointConfig,
+                                        CommunicationConfig, Config,
+                                        MPIConfig, ParallaxConfig, PSConfig,
+                                        ProfileConfig)
+from parallax_tpu.common.lib import parallax_log as log
+from parallax_tpu.core.engine import Model, TrainState
+from parallax_tpu.parallel.partitions import get_partitioner
+from parallax_tpu.runner import parallel_run
+from parallax_tpu.session import ParallaxSession
+from parallax_tpu import ops, shard
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "get_partitioner", "parallel_run", "shard", "log", "Config",
+    "ParallaxConfig", "PSConfig", "MPIConfig", "CommunicationConfig",
+    "CheckPointConfig", "ProfileConfig", "Model", "TrainState",
+    "ParallaxSession", "ops",
+]

@@ -30,15 +30,6 @@ class CheckpointHook:
         if self._config.ckpt_dir:
             import orbax.checkpoint as ocp
             import os
-            if (self._config.save_ckpt_steps is None
-                    and self._config.save_ckpt_secs is None):
-                # ckpt_dir without a trigger would silently never save;
-                # default to the reference stack's 600s cadence
-                # (MonitoredTrainingSession default).
-                self._config.save_ckpt_secs = 600.0
-                parallax_log.info(
-                    "ckpt_dir set without save_ckpt_steps/secs; "
-                    "defaulting to save_ckpt_secs=600")
             # All step/secs gating happens in maybe_save; Orbax's own
             # interval gate must not second-guess it (it would silently
             # drop secs-triggered saves), hence save_interval_steps=1 and

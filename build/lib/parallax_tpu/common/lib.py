@@ -1,0 +1,186 @@
+"""Cluster/resource utilities and the framework logger.
+
+TPU-native counterpart of the reference's common/lib.py:
+  * ``parallax_log``               (reference lib.py:58-67)
+  * ``parse_resource_info``        (reference lib.py:121-150)
+  * ``serialize_resource_info`` /
+    ``deserialize_resource_info``  (reference lib.py:153-176)
+  * ``remote_exec`` / ``remote_copy`` (reference lib.py:70-98) — kept for the
+    multi-host DCN bootstrap path; on TPU pods the JAX coordinator replaces
+    ssh for the data plane, ssh remains only to start per-host processes.
+
+The reference's resource file format is one line per host::
+
+    hostname[: dev,dev,...]
+
+and GPUs are auto-detected over ssh when the device list is omitted
+(reference lib.py:101-103). We keep the exact grammar; the device list now
+names TPU chip indices on that host, and omission means "all local chips"
+(resolved at runtime on each host from jax.local_devices()).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import subprocess
+import sys
+from typing import List, Optional, Sequence
+
+from parallax_tpu.common import consts
+
+# --------------------------------------------------------------------------
+# Logging (reference lib.py:58-67)
+# --------------------------------------------------------------------------
+
+parallax_log = logging.getLogger("PARALLAX")
+if not parallax_log.handlers:
+    _handler = logging.StreamHandler(sys.stderr)
+    _handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s: %(message)s"))
+    parallax_log.addHandler(_handler)
+parallax_log.setLevel(os.environ.get(consts.PARALLAX_LOG_LEVEL, "INFO"))
+
+
+# --------------------------------------------------------------------------
+# Resource info
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class HostInfo:
+    """One line of the resource file: a host and its chip indices.
+
+    ``devices`` is None when the line omitted the list, meaning "every chip
+    on that host" (resolved per-host at runtime).
+    """
+
+    hostname: str
+    devices: Optional[tuple[int, ...]] = None
+
+    def to_json(self):
+        return {"hostname": self.hostname,
+                "devices": list(self.devices) if self.devices else None}
+
+    @staticmethod
+    def from_json(d) -> "HostInfo":
+        devs = d.get("devices")
+        return HostInfo(d["hostname"], tuple(devs) if devs else None)
+
+
+def _parse_resource_line(line: str) -> Optional[HostInfo]:
+    line = line.split("#", 1)[0].strip()
+    if not line:
+        return None
+    if ":" in line:
+        host, devs = line.split(":", 1)
+        host = host.strip()
+        dev_ids = tuple(
+            int(tok) for tok in devs.replace(",", " ").split() if tok)
+        if not host:
+            raise ValueError(f"bad resource line: {line!r}")
+        return HostInfo(host, dev_ids if dev_ids else None)
+    return HostInfo(line)
+
+
+def parse_resource_info(resource_info: str) -> List[HostInfo]:
+    """Parse a resource spec (reference lib.py:121-150).
+
+    ``resource_info`` may be a path to a file or the literal spec text
+    (newline- or semicolon-separated). Grammar per entry::
+
+        hostname[: chip,chip,...]
+    """
+    if resource_info is None:
+        return [HostInfo("localhost")]
+    text = resource_info
+    if os.path.exists(resource_info):
+        with open(resource_info) as f:
+            text = f.read()
+    hosts: List[HostInfo] = []
+    for line in text.replace(";", "\n").splitlines():
+        parsed = _parse_resource_line(line)
+        if parsed is not None:
+            hosts.append(parsed)
+    if not hosts:
+        raise ValueError(f"no hosts found in resource_info: {resource_info!r}")
+    seen = set()
+    for h in hosts:
+        if h.hostname in seen:
+            raise ValueError(f"duplicate host {h.hostname!r} in resource_info")
+        seen.add(h.hostname)
+    return hosts
+
+
+def serialize_resource_info(hosts: Sequence[HostInfo]) -> str:
+    """Env-var transportable form (reference lib.py:153-176 used a custom
+    string grammar; JSON is equivalent and less error-prone)."""
+    return json.dumps([h.to_json() for h in hosts])
+
+
+def deserialize_resource_info(serialized: str) -> List[HostInfo]:
+    return [HostInfo.from_json(d) for d in json.loads(serialized)]
+
+
+# --------------------------------------------------------------------------
+# Remote execution (control plane only; reference lib.py:70-98)
+# --------------------------------------------------------------------------
+
+
+def remote_exec(command: str,
+                hostname: str,
+                env: Optional[dict] = None,
+                stdout=None,
+                stderr=None,
+                python_venv: Optional[str] = None) -> subprocess.Popen:
+    """Run ``command`` on ``hostname`` over ssh with env prepended.
+
+    Mirrors reference lib.py:79-98 (incl. the venv-activation prefix). Used
+    only by the multi-host launcher to start per-host processes; all training
+    data-plane traffic is XLA collectives.
+    """
+    env = dict(env or {})
+    exports = " ".join(
+        f"export {k}={_shell_quote(str(v))};" for k, v in env.items())
+    prefix = f"source {python_venv}/bin/activate; " if python_venv else ""
+    full = f"{exports} {prefix}{command}"
+    if hostname in ("localhost", "127.0.0.1"):
+        proc = subprocess.Popen(["bash", "-c", full], stdout=stdout,
+                                stderr=stderr)
+    else:
+        parallax_log.info("ssh %s: %s", hostname, command)
+        proc = subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", hostname, full],
+            stdout=stdout, stderr=stderr)
+    return proc
+
+
+def remote_copy(local_path: str, remote_path: str, hostname: str) -> None:
+    """scp a file to a host (reference lib.py:70-76)."""
+    if hostname in ("localhost", "127.0.0.1"):
+        if os.path.abspath(local_path) != os.path.abspath(remote_path):
+            subprocess.check_call(["cp", local_path, remote_path])
+        return
+    subprocess.check_call(
+        ["scp", "-o", "StrictHostKeyChecking=no", local_path,
+         f"{hostname}:{remote_path}"])
+
+
+def _shell_quote(s: str) -> str:
+    return "'" + s.replace("'", "'\\''") + "'"
+
+
+# --------------------------------------------------------------------------
+# Redirect helpers (reference ps/runner.py:34-46)
+# --------------------------------------------------------------------------
+
+
+def open_redirect_files(redirect_path: str, job: str, task: int):
+    """Create per-process log files log_{job}{task}_{stdout,stderr}."""
+    os.makedirs(redirect_path, exist_ok=True)
+    out = open(os.path.join(redirect_path, f"log_{job}{task}_stdout"), "w")
+    err = open(os.path.join(redirect_path, f"log_{job}{task}_stderr"), "w")
+    return out, err

@@ -1,0 +1,180 @@
+"""Trace-time dense/sparse variable classification.
+
+The reference classifies each trainable variable by the runtime type of its
+gradient — `Tensor` (dense) vs `IndexedSlices` (sparse) — recorded by the
+forked `tf.gradients` into GRADIENTS_INFO (reference: common/runner.py:40-60).
+A variable gets an IndexedSlices grad exactly when it is consumed *only*
+through `tf.gather`/embedding-lookup.
+
+JAX has no IndexedSlices: the analogue is structural. We trace the user's
+loss function to a jaxpr and walk it: a parameter leaf is SPARSE iff every
+use of it (transitively through dtype casts and sub-jaxprs of
+pjit/scan/cond/while/custom-vjp) is as the *operand* (position 0) of a
+`gather` primitive — i.e. its cotangent is a pure scatter-add of rows.  Any
+other use makes the cotangent dense, so the leaf is DENSE, matching the
+reference's semantics exactly.
+
+User override: `Model(sparse_params=[...])` forces paths sparse, and
+`Model(dense_params=[...])` forces dense, mirroring the reference's implicit
+override of writing the model without `tf.gather`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence
+
+import jax
+from jax.extend.core import Literal
+from jax.tree_util import tree_flatten_with_path, keystr
+
+from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.core import specs as specs_lib
+
+# Primitives that merely forward their (sole) input value: a gather through
+# one of these still yields a row-structured cotangent.
+_PASSTHROUGH_PRIMS = frozenset({"convert_element_type", "copy"})
+
+# Uses recorded per jaxpr variable.
+_USE_GATHER_OPERAND = "gather_operand"
+_USE_OTHER = "other"
+
+
+def leaf_path_names(tree) -> List[str]:
+    """Flatten a pytree into canonical 'a/b/c' path strings (leaf order)."""
+    flat, _ = tree_flatten_with_path(tree)
+    return [_pathname(path) for path, _ in flat]
+
+
+def _pathname(path) -> str:
+    # keystr gives "['a']['b']" / ".a.b" style; normalize to a/b.
+    s = keystr(path)
+    for ch in ("[", "]", "'", '"'):
+        s = s.replace(ch, "/" if ch == "]" else "")
+    parts = [p for p in s.replace(".", "/").split("/") if p]
+    return "/".join(parts)
+
+
+def classify_params(
+    loss_fn: Callable,
+    params,
+    example_batch,
+    *extra_args,
+    sparse_override: Sequence[str] = (),
+    dense_override: Sequence[str] = (),
+) -> Dict[str, specs_lib.VariableSpec]:
+    """Return {path: VariableSpec} for every leaf of ``params``.
+
+    ``loss_fn(params, batch, *extra_args)`` is traced abstractly (no FLOPs,
+    no device memory) with jax.make_jaxpr.
+    """
+    flat, _ = tree_flatten_with_path(params)
+    paths = [_pathname(p) for p, _ in flat]
+    n_params = len(flat)
+
+    closed = jax.make_jaxpr(loss_fn)(params, example_batch, *extra_args)
+    jaxpr = closed.jaxpr
+    # (params, batch, *extra) flatten with params leaves first, in tree order.
+    param_invars = jaxpr.invars[:n_params]
+
+    uses: Dict[Any, set] = {}
+    _collect_uses(jaxpr, uses)
+
+    out: Dict[str, specs_lib.VariableSpec] = {}
+    for path, (_, leaf), invar in zip(paths, flat, param_invars):
+        leaf_uses = uses.get(invar, set())
+        if path in sparse_override:
+            kind, reason = specs_lib.SPARSE, "user override"
+        elif path in dense_override:
+            kind, reason = specs_lib.DENSE, "user override"
+        elif leaf_uses == {_USE_GATHER_OPERAND}:
+            kind, reason = specs_lib.SPARSE, "all uses are gather operands"
+        elif _USE_GATHER_OPERAND in leaf_uses:
+            kind = specs_lib.DENSE
+            reason = "gathered but also used densely"
+        else:
+            kind, reason = specs_lib.DENSE, "no gather use"
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        out[path] = specs_lib.VariableSpec(path, shape, dtype, kind, reason)
+    parallax_log.info("classified %s", specs_lib.summarize(out))
+    return out
+
+
+def _collect_uses(jaxpr, uses: Dict[Any, set],
+                  alias: Dict[Any, Any] | None = None) -> None:
+    """Walk a jaxpr recording how each variable is consumed.
+
+    ``alias`` maps inner jaxpr vars to the canonical (outermost) var they
+    carry, so uses inside sub-jaxprs are charged to the outer parameter.
+    Pass-through primitives extend the alias chain.
+    """
+    alias = alias or {}
+
+    def canon(v):
+        return alias.get(v, v)
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        sub = _sub_jaxprs(eqn)
+        if prim in _PASSTHROUGH_PRIMS and len(eqn.invars) == 1:
+            src = eqn.invars[0]
+            if not isinstance(src, Literal):
+                alias[eqn.outvars[0]] = canon(src)
+            continue
+        if sub:
+            for inner_jaxpr, outer_operands in sub:
+                inner_alias = dict(alias)
+                for inner_v, outer_v in zip(inner_jaxpr.invars,
+                                            outer_operands):
+                    if outer_v is not None and not isinstance(
+                            outer_v, Literal):
+                        inner_alias[inner_v] = canon(outer_v)
+                _collect_uses(inner_jaxpr, uses, inner_alias)
+            continue
+        for pos, v in enumerate(eqn.invars):
+            if isinstance(v, Literal):
+                continue
+            cv = canon(v)
+            tag = (_USE_GATHER_OPERAND
+                   if prim == "gather" and pos == 0 else _USE_OTHER)
+            uses.setdefault(cv, set()).add(tag)
+
+
+def _sub_jaxprs(eqn):
+    """Yield (inner_jaxpr, outer_operands_aligned_to_inner_invars) pairs.
+
+    Handles the higher-order primitives whose operand->invar mapping we can
+    reconstruct; anything else falls through and its operands are recorded
+    as opaque dense uses (safe default).
+    """
+    prim = eqn.primitive.name
+    p = eqn.params
+    if prim in ("pjit", "jit", "closed_call", "core_call"):
+        j = p.get("jaxpr") or p.get("call_jaxpr")
+        if j is not None:
+            return [(_inner(j), list(eqn.invars))]
+    if prim == "remat" or prim == "checkpoint":
+        return [(_inner(p["jaxpr"]), list(eqn.invars))]
+    if prim in ("custom_jvp_call", "custom_vjp_call",
+                "custom_vjp_call_jaxpr"):
+        j = p.get("call_jaxpr") or p.get("fun_jaxpr")
+        if j is not None:
+            return [(_inner(j), list(eqn.invars))]
+    if prim == "scan":
+        # eqn.invars = [consts, carry_init, xs]; inner invars = [consts,
+        # carry, x_slices] — positionally aligned for identity tracking.
+        return [(_inner(p["jaxpr"]), list(eqn.invars))]
+    if prim == "while":
+        cn, bn = p["cond_nconsts"], p["body_nconsts"]
+        cond_ops = list(eqn.invars[:cn]) + list(eqn.invars[cn + bn:])
+        body_ops = list(eqn.invars[cn:cn + bn]) + list(eqn.invars[cn + bn:])
+        return [(_inner(p["cond_jaxpr"]), cond_ops),
+                (_inner(p["body_jaxpr"]), body_ops)]
+    if prim == "cond":
+        ops = list(eqn.invars[1:])  # invars[0] is the branch index
+        return [(_inner(b), ops) for b in p["branches"]]
+    return []
+
+
+def _inner(j):
+    return j.jaxpr if hasattr(j, "jaxpr") else j

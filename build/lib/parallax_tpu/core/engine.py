@@ -308,20 +308,8 @@ class Engine:
         def put(name, x):
             x = np.asarray(x)
             if name in overrides:
-                spec = overrides[name]
-                for dim, axes in enumerate(spec):
-                    if axes is None:
-                        continue
-                    axes = (axes,) if isinstance(axes, str) else axes
-                    need = int(np.prod([self.mesh.shape[a] for a in axes]))
-                    if dim < x.ndim and x.shape[dim] % need != 0:
-                        raise ValueError(
-                            f"feed {name!r} dim {dim} of size "
-                            f"{x.shape[dim]} is not divisible by the "
-                            f"{need}-way mesh axes {axes} in its "
-                            f"PartitionSpec; pad that dimension")
                 return jax.device_put(
-                    x, NamedSharding(self.mesh, spec))
+                    x, NamedSharding(self.mesh, overrides[name]))
             if x.ndim >= 1 and x.shape[0] % n != 0:
                 raise ValueError(
                     f"batch dimension {x.shape[0]} is not divisible by the "

@@ -1,0 +1,90 @@
+"""Device-mesh construction.
+
+The TPU-native replacement for the reference's cluster topology handling
+(reference: common/lib.py:267-279 builds a tf.train.ClusterSpec; the per-mode
+runners then map graph pieces onto /job:{ps,worker}/task:N devices). Here the
+"cluster" is a `jax.sharding.Mesh` and placement is a `PartitionSpec` per
+variable — no per-op device strings.
+
+Mesh layout: a 2-D mesh ``('repl', 'shard')`` over all visible devices.
+
+  * The *batch* axis of every input is sharded over both axes flattened —
+    pure data parallelism, every device computes a batch slice.
+  * Dense variables are replicated over the whole mesh (reference: Horovod
+    mirror-per-GPU, mpi/graph_transform.py:35-61).
+  * Sparse variables are row-sharded over ``'shard'`` and replicated over
+    ``'repl'`` (reference: tf.fixed_size_partitioner shards over PS tasks,
+    ps/between_graph_parallel.py:49-70).
+
+``num_partitions`` (the reference's embedding partition count, auto-searched
+by partitions.py) therefore maps to the size of the ``'shard'`` axis: p=1
+means every device holds the full table (cheap lookups, all-reduce grads);
+p=N means fully sharded rows (minimal memory, all-to-all row exchange). The
+partition auto-search varies p and re-jits — no cluster restart needed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from parallax_tpu.common.lib import parallax_log
+
+AXIS_REPL = "repl"
+AXIS_SHARD = "shard"
+# Spec helpers used across the engine.
+BATCH_AXES = (AXIS_REPL, AXIS_SHARD)
+
+
+def batch_spec(ndim: int = 1) -> P:
+    """Batch sharded over the flattened mesh on dim 0."""
+    return P(BATCH_AXES, *([None] * (ndim - 1)))
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def row_sharded_spec(ndim: int) -> P:
+    """Row-sharded over 'shard', replicated over 'repl' (sparse variables)."""
+    return P(AXIS_SHARD, *([None] * (ndim - 1)))
+
+
+def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
+               num_partitions: Optional[int] = None) -> Mesh:
+    """Build the ('repl', 'shard') mesh.
+
+    ``num_partitions`` is clamped to a divisor of the device count (the
+    reference's fixed_size_partitioner accepts any count because PS tasks can
+    hold uneven slices; XLA sharding wants even splits, so we snap to the
+    nearest divisor <= requested, logging when we do).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    p = num_partitions if num_partitions else n
+    p = max(1, min(p, n))
+    if n % p != 0:
+        snapped = max(d for d in range(1, p + 1) if n % d == 0)
+        parallax_log.warning(
+            "num_partitions=%d does not divide device count %d; "
+            "snapping to %d", p, n, snapped)
+        p = snapped
+    arr = np.asarray(devices).reshape(n // p, p)
+    return Mesh(arr, (AXIS_REPL, AXIS_SHARD))
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def num_shards(mesh: Mesh) -> int:
+    return mesh.shape[AXIS_SHARD]
+
+
+def num_devices(mesh: Mesh) -> int:
+    return mesh.shape[AXIS_REPL] * mesh.shape[AXIS_SHARD]

@@ -1,0 +1,3 @@
+from parallax_tpu.data.loader import TokenDataset, write_token_file
+
+__all__ = ["TokenDataset", "write_token_file"]

@@ -3,10 +3,8 @@
 The python face of the C++ loader (cpp/dataloader.cc): mmap'd int32 token
 files, background prefetch, mod-filter sharding identical to the shard
 API (reference shard.py:69-87 semantics at the window level). Falls back
-to a pure-numpy implementation with the same window/shard/epoch semantics
-when the native library can't be built (no toolchain). Each backend is
-deterministic for a given seed, but the two backends' per-epoch batch
-*orders* differ (std::mt19937 vs PCG64 shuffles).
+to a pure-numpy implementation with the same observable behavior when
+the native library can't be built (no toolchain).
 
 The native library is built on demand with g++ next to the module and
 cached; set PARALLAX_DATA_BACKEND=numpy to force the fallback.
@@ -45,14 +43,10 @@ def _native_lib() -> Optional[ctypes.CDLL]:
     elif (not os.path.exists(so_path)
           or os.path.getmtime(so_path) < os.path.getmtime(src)):
         try:
-            # build to a per-pid temp then rename atomically so
-            # concurrent processes never dlopen a half-written library
-            tmp_path = f"{so_path}.{os.getpid()}.tmp"
             subprocess.check_call(
                 ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                 "-pthread", "-o", tmp_path, src],
+                 "-pthread", "-o", so_path, src],
                 stderr=subprocess.DEVNULL)
-            os.replace(tmp_path, so_path)
         except (OSError, subprocess.CalledProcessError) as e:
             parallax_log.warning(
                 "native dataloader build failed (%s); using numpy "

@@ -1,0 +1,95 @@
+"""Multi-host launch: the control plane.
+
+Reference: the master process ssh/mpirun-spawns the user's own driver script
+on every host with PARALLAX_* env injected, then waits on the chief
+(reference: common/runner.py:139-193, ps/runner.py:163-193,
+mpi/runner.py:87-131). We keep exactly that shape — re-execute
+``sys.argv`` on each host over ssh with env — but the spawned processes
+coordinate through the JAX distributed service (one coordinator, ICI/DCN
+collectives) instead of gRPC PS servers or mpirun.
+
+On Cloud TPU pods the per-host processes are normally started by the pod
+runtime and `jax.distributed.initialize()` discovers everything; this
+launcher exists for parity with the reference's "bring your own hosts over
+ssh" workflow (DCN clusters, CPU test rigs).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+from typing import List, Sequence
+
+from parallax_tpu.common import consts
+from parallax_tpu.common.lib import (HostInfo, _shell_quote, parallax_log,
+                                     remote_exec, serialize_resource_info)
+
+
+def launch_workers(hosts: Sequence[HostInfo],
+                   redirect_path: str | None = None) -> int:
+    """Spawn the current script on every host; wait on the chief; SIGINT the
+    rest on exit (reference runner.py:124-136 cleanup semantics).
+
+    Returns the chief's exit code.
+    """
+    coordinator = (f"{hosts[0].hostname}:"
+                   f"{consts.PARALLAX_COORDINATOR_PORT_DEFAULT}")
+    serialized = serialize_resource_info(hosts)
+    cmd = (_shell_quote(sys.executable) + " "
+           + " ".join(_shell_quote(a) for a in sys.argv))
+    procs: List = []
+    # Reverse order, chief last (reference ps/runner.py:163-193: the chief
+    # must come up after its peers are listening).
+    for machine_id in reversed(range(len(hosts))):
+        host = hosts[machine_id]
+        env = {
+            consts.PARALLAX_RUN_OPTION: "WORKER",
+            consts.PARALLAX_MACHINE_ID: machine_id,
+            consts.PARALLAX_NUM_WORKERS: len(hosts),
+            consts.PARALLAX_HOSTNAME: host.hostname,
+            consts.PARALLAX_RESOURCE_INFO: serialized,
+            consts.PARALLAX_COORDINATOR_ADDRESS: coordinator,
+        }
+        for var in (consts.PARALLAX_MIN_PARTITIONS,
+                    consts.PARALLAX_PARTITIONS, consts.PARALLAX_LOG_LEVEL):
+            if os.environ.get(var):
+                env[var] = os.environ[var]
+        stdout = stderr = None
+        if redirect_path:
+            from parallax_tpu.common.lib import open_redirect_files
+            stdout, stderr = open_redirect_files(redirect_path, "worker",
+                                                 machine_id)
+        parallax_log.info("launching worker %d on %s", machine_id,
+                          host.hostname)
+        procs.append(remote_exec(cmd, host.hostname, env=env, stdout=stdout,
+                                 stderr=stderr))
+    chief = procs[-1]
+    try:
+        rc = chief.wait()
+    except KeyboardInterrupt:
+        rc = 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGINT)
+                except OSError:
+                    pass
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except Exception:
+                p.kill()
+    return rc
+
+
+def init_worker_distributed() -> None:
+    """Join the JAX coordination service using launcher-injected env."""
+    import jax
+    coordinator = os.environ[consts.PARALLAX_COORDINATOR_ADDRESS]
+    num_processes = int(os.environ[consts.PARALLAX_NUM_WORKERS])
+    process_id = int(os.environ[consts.PARALLAX_MACHINE_ID])
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)

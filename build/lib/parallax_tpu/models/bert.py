@@ -1,0 +1,181 @@
+"""BERT pretraining (MLM + NSP) — the stretch config.
+
+BASELINE.json config 5: "BERT-large pretraining (mixed dense layers +
+WordPiece sparse embeddings)". Encoder-only transformer; the WordPiece
+embedding table is gather-only (untied from the MLM output matrix) so the
+classifier routes it to the row-sharded sparse path, while the 24 dense
+layers ride the all-reduce path — the hybrid engine's mixed workload.
+
+MLM logits are computed only for the masked positions (gather of [B, M]
+hidden states), the standard TPU-friendly formulation — static shapes,
+no dynamic masking inside jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from parallax_tpu.core.engine import Model
+from parallax_tpu.ops import embedding as emb_ops
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_dim: int = 1024          # BERT-large
+    num_heads: int = 16
+    mlp_dim: int = 4096
+    num_layers: int = 24
+    max_len: int = 512
+    type_vocab: int = 2
+    learning_rate: float = 1e-4
+    num_partitions: Optional[int] = None
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def padded_vocab(self) -> int:
+        return emb_ops.padded_vocab_for(self.vocab_size,
+                                        self.num_partitions)
+
+
+def tiny_config(**kw) -> BertConfig:
+    defaults = dict(vocab_size=500, hidden_dim=32, num_heads=2,
+                    mlp_dim=64, num_layers=2, max_len=32)
+    defaults.update(kw)
+    return BertConfig(**defaults)
+
+
+def build_model(cfg: BertConfig) -> Model:
+    V, D = cfg.padded_vocab, cfg.hidden_dim
+    dt = cfg.compute_dtype
+
+    def dense_init(rng, shape):
+        return jax.random.normal(rng, shape) * 0.02
+
+    def init_fn(rng):
+        ks = jax.random.split(rng, 8 + cfg.num_layers)
+        blocks = []
+        for i in range(cfg.num_layers):
+            bk = jax.random.split(ks[8 + i], 6)
+            blocks.append({
+                "wqkv": dense_init(bk[0], (D, 3 * D)),
+                "wo": dense_init(bk[1], (D, D)),
+                "w1": dense_init(bk[2], (D, cfg.mlp_dim)),
+                "w2": dense_init(bk[3], (cfg.mlp_dim, D)),
+                "ln1": {"s": jnp.ones((D,)), "b": jnp.zeros((D,))},
+                "ln2": {"s": jnp.ones((D,)), "b": jnp.zeros((D,))},
+            })
+        return {
+            "word_emb": dense_init(ks[0], (V, D)),
+            "pos_emb": dense_init(ks[1], (cfg.max_len, D)),
+            "type_emb": dense_init(ks[2], (cfg.type_vocab, D)),
+            "emb_ln": {"s": jnp.ones((D,)), "b": jnp.zeros((D,))},
+            "mlm": {"w": dense_init(ks[3], (D, D)),
+                    "ln": {"s": jnp.ones((D,)), "b": jnp.zeros((D,))},
+                    "out": dense_init(ks[4], (D, V)),
+                    "bias": jnp.zeros((V,))},
+            "nsp": {"pool": dense_init(ks[5], (D, D)),
+                    "out": dense_init(ks[6], (D, 2))},
+            "blocks": blocks,
+        }
+
+    def layer_norm(x, p):
+        m = jnp.mean(x, -1, keepdims=True)
+        v = jnp.var(x, -1, keepdims=True)
+        return ((x - m) * jax.lax.rsqrt(v + 1e-6) * p["s"].astype(x.dtype)
+                + p["b"].astype(x.dtype))
+
+    def attention(x, p, pad_mask):
+        B, T, _ = x.shape
+        Hn = cfg.num_heads
+        hd = D // Hn
+        qkv = x @ p["wqkv"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, -1)
+
+        def heads(z):
+            return z.reshape(B, T, Hn, hd).transpose(0, 2, 1, 3)
+
+        scores = jnp.einsum("bhqd,bhkd->bhqk", heads(q), heads(k),
+                            preferred_element_type=jnp.float32)
+        scores = scores / np.sqrt(hd)
+        scores = jnp.where(pad_mask[:, None, None, :], scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, heads(v))
+        return out.transpose(0, 2, 1, 3).reshape(B, T, D) @ (
+            p["wo"].astype(dt))
+
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        segs = batch["segment_ids"]
+        B, T = ids.shape
+        pad_mask = ids > 0
+
+        x = emb_ops.embedding_lookup(params["word_emb"], ids).astype(dt)
+        x = x + params["pos_emb"][:T].astype(dt)[None]
+        x = x + jnp.take(params["type_emb"], segs, axis=0).astype(dt)
+        x = layer_norm(x, params["emb_ln"])
+
+        for p in params["blocks"]:
+            x = layer_norm(x + attention(x, p, pad_mask), p["ln1"])
+            h = jax.nn.gelu(x @ p["w1"].astype(dt)) @ p["w2"].astype(dt)
+            x = layer_norm(x + h, p["ln2"])
+
+        # MLM over masked positions only: [B, M] gathers
+        mpos = batch["mask_positions"]                     # [B, M] int32
+        mlabels = batch["mask_labels"]                     # [B, M]
+        mw = batch["mask_weights"].astype(jnp.float32)     # [B, M]
+        hidden = jnp.take_along_axis(x, mpos[..., None], axis=1)
+        hidden = hidden.astype(jnp.float32)                # [B, M, D]
+        mlm = params["mlm"]
+        hidden = jax.nn.gelu(hidden @ mlm["w"])
+        hidden = layer_norm(hidden, mlm["ln"])
+        logits = hidden @ mlm["out"] + mlm["bias"]
+        logits = emb_ops.mask_padded_logits(logits, cfg.vocab_size)
+        mlm_nll = optax.softmax_cross_entropy_with_integer_labels(
+            logits.reshape(-1, V), mlabels.reshape(-1))
+        mlm_loss = (jnp.sum(mlm_nll * mw.reshape(-1))
+                    / jnp.maximum(jnp.sum(mw), 1e-8))
+
+        # NSP from the [CLS] (position 0) vector
+        cls = jnp.tanh(x[:, 0].astype(jnp.float32) @ params["nsp"]["pool"])
+        nsp_logits = cls @ params["nsp"]["out"]
+        nsp_loss = optax.softmax_cross_entropy_with_integer_labels(
+            nsp_logits, batch["next_sentence_label"]).mean()
+
+        loss = mlm_loss + nsp_loss
+        return loss, {"mlm_loss": mlm_loss, "nsp_loss": nsp_loss,
+                      "masked_tokens": jnp.sum(mw)}
+
+    tx = optax.chain(optax.clip_by_global_norm(1.0),
+                     optax.adamw(cfg.learning_rate, weight_decay=0.01))
+    # type_emb is gathered but tiny (2 rows) — keep it replicated rather
+    # than letting the classifier try to shard it
+    return Model(init_fn, loss_fn, optimizer=tx,
+                 dense_params=("type_emb",))
+
+
+def make_batch(rng: np.random.Generator, batch_size: int, seq_len: int,
+               num_masked: int, vocab_size: int):
+    ids = rng.integers(5, vocab_size, (batch_size, seq_len))
+    segs = np.zeros((batch_size, seq_len), np.int32)
+    segs[:, seq_len // 2:] = 1
+    mpos = np.stack([rng.choice(seq_len, num_masked, replace=False)
+                     for _ in range(batch_size)]).astype(np.int32)
+    mlabels = np.take_along_axis(ids, mpos, axis=1).astype(np.int32)
+    ids_masked = ids.copy()
+    np.put_along_axis(ids_masked, mpos, 3, axis=1)  # [MASK]=3
+    return {
+        "input_ids": ids_masked.astype(np.int32),
+        "segment_ids": segs,
+        "mask_positions": mpos,
+        "mask_labels": mlabels,
+        "mask_weights": np.ones((batch_size, num_masked), np.float32),
+        "next_sentence_label": rng.integers(0, 2, (batch_size,))
+                                  .astype(np.int32),
+    }

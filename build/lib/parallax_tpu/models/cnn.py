@@ -1,0 +1,121 @@
+"""CNN benchmark registry + parallax Model adapter.
+
+Capability parity with the reference's model_config registry and benchmark
+driver (reference: examples/tf_cnn_benchmarks/models/model_config.py and
+CNNBenchmark_distributed_driver.py:50-91): named models, per-model default
+image sizes, SGD-momentum training with weight decay, steps/sec metric.
+
+These are pure dense models — through the hybrid engine they exercise the
+all-reduce path (reference MPI mode): parameters replicated, gradients
+all-reduced over ICI, batch data-parallel. BatchNorm statistics flow
+through the engine's model_state and reduce over the *global* batch
+because the whole step is one SPMD program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from parallax_tpu.core.engine import Model
+from parallax_tpu.models import cnn_zoo, resnet
+
+# name -> (module factory, default image size)
+# (reference model_config.py model name -> model class mapping)
+MODEL_REGISTRY: Dict[str, Tuple[Any, int]] = {
+    "trivial": (cnn_zoo.TrivialModel, 224),
+    "lenet": (cnn_zoo.LeNet, 28),
+    "alexnet": (cnn_zoo.AlexNet, 224),
+    "vgg11": (cnn_zoo.VGG11, 224),
+    "vgg16": (cnn_zoo.VGG16, 224),
+    "vgg19": (cnn_zoo.VGG19, 224),
+    "overfeat": (cnn_zoo.Overfeat, 231),
+    "googlenet": (cnn_zoo.GoogLeNet, 224),
+    "inception3": (cnn_zoo.InceptionV3, 299),
+    "resnet50": (lambda **kw: resnet.ResNet50(v1_5=False, **kw), 224),
+    "resnet50_v1.5": (lambda **kw: resnet.ResNet50(v1_5=True, **kw), 224),
+    "resnet101": (lambda **kw: resnet.ResNet101(v1_5=False, **kw), 224),
+    "resnet152": (lambda **kw: resnet.ResNet152(v1_5=False, **kw), 224),
+    "densenet121": (cnn_zoo.DenseNet, 224),
+}
+
+
+def default_image_size(name: str) -> int:
+    return MODEL_REGISTRY[name][1]
+
+
+def build_model(name: str,
+                num_classes: int = 1000,
+                image_size: Optional[int] = None,
+                learning_rate: float = 0.1,
+                momentum: float = 0.9,
+                weight_decay: float = 4e-5) -> Model:
+    """Wrap a zoo architecture as a parallax Model.
+
+    weight_decay=4e-5 matches the reference benchmark default
+    (tf_cnn_benchmarks flags).
+    """
+    if name not in MODEL_REGISTRY:
+        raise ValueError(
+            f"unknown model {name!r}; available: "
+            f"{sorted(MODEL_REGISTRY)}")
+    factory, default_size = MODEL_REGISTRY[name]
+    size = image_size or default_size
+    module = factory(num_classes=num_classes)
+    sample = jnp.zeros((2, size, size, 3), jnp.float32)
+
+    # Detect mutable state (BatchNorm) abstractly — no FLOPs.
+    var_shapes = jax.eval_shape(
+        lambda r: module.init(r, sample, train=True), jax.random.PRNGKey(0))
+    stateful = any(k != "params" for k in var_shapes)
+
+    def init_fn(rng):
+        variables = module.init(rng, sample, train=True)
+        params = variables.pop("params")
+        if stateful:
+            return params, dict(variables)
+        return params
+
+    def make_loss(logits, labels):
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels)
+                       .astype(jnp.float32))
+        return ce, acc
+
+    if stateful:
+        def loss_fn(params, model_state, batch, rng):
+            logits, new_vars = module.apply(
+                {"params": params, **model_state}, batch["images"],
+                train=True, mutable=list(model_state.keys()))
+            loss, acc = make_loss(logits, batch["labels"])
+            return loss, {"accuracy": acc}, dict(new_vars)
+    else:
+        def loss_fn(params, batch, rng):
+            logits = module.apply({"params": params}, batch["images"],
+                                  train=True)
+            loss, acc = make_loss(logits, batch["labels"])
+            return loss, {"accuracy": acc}
+
+    tx = optax.chain(
+        optax.add_decayed_weights(
+            weight_decay, mask=lambda p: jax.tree.map(
+                lambda x: x.ndim > 1, p)),
+        optax.sgd(learning_rate, momentum=momentum))
+    return Model(init_fn, loss_fn, optimizer=tx, stateful=stateful)
+
+
+def make_batch(rng: np.random.Generator, batch_size: int, image_size: int,
+               num_classes: int = 1000):
+    """Synthetic ImageNet-like batch (the reference benchmark's
+    --data_name=synthetic mode)."""
+    return {
+        "images": rng.standard_normal(
+            (batch_size, image_size, image_size, 3)).astype(np.float32),
+        "labels": rng.integers(0, num_classes,
+                               (batch_size,)).astype(np.int32),
+    }

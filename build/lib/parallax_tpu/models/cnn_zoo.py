@@ -1,0 +1,317 @@
+"""The remaining CNN-benchmark architectures.
+
+Capability parity with the reference's model zoo
+(reference: examples/tf_cnn_benchmarks/models/ — alexnet, vgg 11/16/19,
+lenet, overfeat, trivial, googlenet (inception-v1), inception-v3,
+densenet). All flax linen, NHWC, bf16 compute / f32 params.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TrivialModel(nn.Module):
+    """reference models/trivial_model.py: flatten -> fc."""
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype).reshape((x.shape[0], -1))
+        x = nn.Dense(4096, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class LeNet(nn.Module):
+    """reference models/lenet_model.py."""
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (5, 5), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (5, 5), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class AlexNet(nn.Module):
+    """reference models/alexnet_model.py."""
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(64, (11, 11), strides=(4, 4), padding="VALID",
+                            dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(192, (5, 5), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(384, (3, 3), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(384, (3, 3), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class VGG(nn.Module):
+    """reference models/vgg_model.py: vgg11/16/19 by conv counts."""
+    conv_counts: Sequence[int]
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        widths = (64, 128, 256, 512, 512)
+        for stage, (count, width) in enumerate(zip(self.conv_counts,
+                                                   widths)):
+            for _ in range(count):
+                x = nn.relu(nn.Conv(width, (3, 3), dtype=self.dtype)(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+VGG11 = partial(VGG, conv_counts=(1, 1, 2, 2, 2))
+VGG16 = partial(VGG, conv_counts=(2, 2, 3, 3, 3))
+VGG19 = partial(VGG, conv_counts=(2, 2, 4, 4, 4))
+
+
+class Overfeat(nn.Module):
+    """reference models/overfeat_model.py."""
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(96, (11, 11), strides=(4, 4), padding="VALID",
+                            dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(256, (5, 5), padding="VALID",
+                            dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(512, (3, 3), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(1024, (3, 3), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(1024, (3, 3), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(3072, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class InceptionBranch(nn.Module):
+    """1x1 -> optional (k,k) conv chain, each conv+relu."""
+    specs: Sequence[tuple]  # ((filters, kernel, strides, padding), ...)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        for (f, k, s, p) in self.specs:
+            x = nn.relu(nn.Conv(f, k, strides=s, padding=p,
+                                dtype=self.dtype)(x))
+        return x
+
+
+class GoogLeNet(nn.Module):
+    """Inception-v1 (reference models/googlenet_model.py)."""
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    def inception(self, x, c1, c3r, c3, c5r, c5, pp):
+        d = self.dtype
+        b1 = InceptionBranch([(c1, (1, 1), (1, 1), "SAME")], d)(x)
+        b2 = InceptionBranch([(c3r, (1, 1), (1, 1), "SAME"),
+                              (c3, (3, 3), (1, 1), "SAME")], d)(x)
+        b3 = InceptionBranch([(c5r, (1, 1), (1, 1), "SAME"),
+                              (c5, (5, 5), (1, 1), "SAME")], d)(x)
+        b4 = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = InceptionBranch([(pp, (1, 1), (1, 1), "SAME")], d)(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(64, (7, 7), strides=(2, 2),
+                            dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.relu(nn.Conv(64, (1, 1), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(192, (3, 3), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = self.inception(x, 64, 96, 128, 16, 32, 32)
+        x = self.inception(x, 128, 128, 192, 32, 96, 64)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = self.inception(x, 192, 96, 208, 16, 48, 64)
+        x = self.inception(x, 160, 112, 224, 24, 64, 64)
+        x = self.inception(x, 128, 128, 256, 24, 64, 64)
+        x = self.inception(x, 112, 144, 288, 32, 64, 64)
+        x = self.inception(x, 256, 160, 320, 32, 128, 128)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = self.inception(x, 256, 160, 320, 32, 128, 128)
+        x = self.inception(x, 384, 192, 384, 48, 128, 128)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class ConvBN(nn.Module):
+    filters: int
+    kernel: tuple
+    strides: tuple = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.filters, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         param_dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+class InceptionV3(nn.Module):
+    """Inception-v3 (reference models/inception_model.py). Canonical
+    tower structure with 5x inception-A/4x B/2x C style mix; input
+    299x299 (224 also works)."""
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        cbn = partial(ConvBN, dtype=d)
+        x = x.astype(d)
+        x = cbn(32, (3, 3), (2, 2), "VALID")(x, train)
+        x = cbn(32, (3, 3), (1, 1), "VALID")(x, train)
+        x = cbn(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = cbn(80, (1, 1), (1, 1), "VALID")(x, train)
+        x = cbn(192, (3, 3), (1, 1), "VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+
+        def block_a(x, pool_f):
+            b1 = cbn(64, (1, 1))(x, train)
+            b2 = cbn(48, (1, 1))(x, train)
+            b2 = cbn(64, (5, 5))(b2, train)
+            b3 = cbn(64, (1, 1))(x, train)
+            b3 = cbn(96, (3, 3))(b3, train)
+            b3 = cbn(96, (3, 3))(b3, train)
+            b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            b4 = cbn(pool_f, (1, 1))(b4, train)
+            return jnp.concatenate([b1, b2, b3, b4], -1)
+
+        x = block_a(x, 32)
+        x = block_a(x, 64)
+        x = block_a(x, 64)
+
+        # reduction A
+        b1 = cbn(384, (3, 3), (2, 2), "VALID")(x, train)
+        b2 = cbn(64, (1, 1))(x, train)
+        b2 = cbn(96, (3, 3))(b2, train)
+        b2 = cbn(96, (3, 3), (2, 2), "VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = jnp.concatenate([b1, b2, b3], -1)
+
+        def block_b(x, c7):
+            b1 = cbn(192, (1, 1))(x, train)
+            b2 = cbn(c7, (1, 1))(x, train)
+            b2 = cbn(c7, (1, 7))(b2, train)
+            b2 = cbn(192, (7, 1))(b2, train)
+            b3 = cbn(c7, (1, 1))(x, train)
+            b3 = cbn(c7, (7, 1))(b3, train)
+            b3 = cbn(c7, (1, 7))(b3, train)
+            b3 = cbn(c7, (7, 1))(b3, train)
+            b3 = cbn(192, (1, 7))(b3, train)
+            b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            b4 = cbn(192, (1, 1))(b4, train)
+            return jnp.concatenate([b1, b2, b3, b4], -1)
+
+        x = block_b(x, 128)
+        x = block_b(x, 160)
+        x = block_b(x, 160)
+        x = block_b(x, 192)
+
+        # reduction B
+        b1 = cbn(192, (1, 1))(x, train)
+        b1 = cbn(320, (3, 3), (2, 2), "VALID")(b1, train)
+        b2 = cbn(192, (1, 1))(x, train)
+        b2 = cbn(192, (1, 7))(b2, train)
+        b2 = cbn(192, (7, 1))(b2, train)
+        b2 = cbn(192, (3, 3), (2, 2), "VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = jnp.concatenate([b1, b2, b3], -1)
+
+        def block_c(x):
+            b1 = cbn(320, (1, 1))(x, train)
+            b2 = cbn(384, (1, 1))(x, train)
+            b2 = jnp.concatenate([cbn(384, (1, 3))(b2, train),
+                                  cbn(384, (3, 1))(b2, train)], -1)
+            b3 = cbn(448, (1, 1))(x, train)
+            b3 = cbn(384, (3, 3))(b3, train)
+            b3 = jnp.concatenate([cbn(384, (1, 3))(b3, train),
+                                  cbn(384, (3, 1))(b3, train)], -1)
+            b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            b4 = cbn(192, (1, 1))(b4, train)
+            return jnp.concatenate([b1, b2, b3, b4], -1)
+
+        x = block_c(x)
+        x = block_c(x)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class DenseNet(nn.Module):
+    """DenseNet-121 style (reference models/densenet_model.py)."""
+    stage_sizes: Sequence[int] = (6, 12, 24, 16)
+    growth_rate: int = 32
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=d,
+                       param_dtype=jnp.float32)
+        x = x.astype(d)
+        x = nn.Conv(2 * self.growth_rate, (7, 7), strides=(2, 2),
+                    use_bias=False, dtype=d)(x)
+        x = nn.relu(norm()(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for _ in range(n_blocks):
+                y = nn.relu(norm()(x))
+                y = nn.Conv(4 * self.growth_rate, (1, 1), use_bias=False,
+                            dtype=d)(y)
+                y = nn.relu(norm()(y))
+                y = nn.Conv(self.growth_rate, (3, 3), use_bias=False,
+                            dtype=d)(y)
+                x = jnp.concatenate([x, y], -1)
+            if i < len(self.stage_sizes) - 1:
+                x = nn.relu(norm()(x))
+                x = nn.Conv(x.shape[-1] // 2, (1, 1), use_bias=False,
+                            dtype=d)(x)
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(norm()(x))
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,172 @@
+"""LM1B language model — the flagship sparse/hybrid workload.
+
+Re-expression of the reference's LM1B example
+(reference: examples/lm1b/language_model.py and language_model_graph.py):
+a single-layer LSTM with projection over a 793,470-word vocabulary,
+log-uniform sampled softmax (num_samples=8192), embedding and softmax
+variables partitioned across the sparse path
+(language_model.py:33-45 uses parallax.get_partitioner for both).
+
+TPU-native design decisions:
+  * the recurrence is a `lax.scan` over time — static shapes, one fused
+    [B, E+P] x [E+P, 4H] matmul per step on the MXU;
+  * embedding + softmax weight + softmax bias are gather-only tables ->
+    the trace-time classifier routes all three to the row-sharded path;
+    vocab is padded so rows split evenly for any divisor of the device
+    count (partition auto-search reshards without shape changes);
+  * sampled softmax is one fused gather for labels+candidates (see
+    ops/sampled_softmax.py);
+  * compute runs in bfloat16 (MXU native), params/optimizer in float32.
+
+Batch contract matches the reference driver
+(examples/lm1b/lm1b_distributed_driver.py:84-96): feeds "x" [B, T] int32,
+"y" [B, T] int32, "w" [B, T] float weights; metric words/sec derives
+from sum(w) per step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from parallax_tpu.core.engine import Model
+from parallax_tpu.ops import embedding as emb_ops
+from parallax_tpu.ops import sampled_softmax as ss_ops
+
+
+@dataclasses.dataclass
+class LM1BConfig:
+    vocab_size: int = 793470          # reference lm1b vocabulary
+    emb_dim: int = 512
+    hidden_dim: int = 2048
+    proj_dim: int = 512
+    num_samples: int = 8192
+    keep_prob: float = 0.9            # reference language_model.py dropout
+    max_grad_norm: float = 10.0
+    learning_rate: float = 0.2
+    num_partitions: Optional[int] = None  # None -> pad for device count
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def padded_vocab(self) -> int:
+        return emb_ops.padded_vocab_for(self.vocab_size,
+                                        self.num_partitions)
+
+
+def tiny_config(**kw) -> LM1BConfig:
+    """Small config for tests / dry runs."""
+    defaults = dict(vocab_size=1000, emb_dim=32, hidden_dim=64,
+                    proj_dim=32, num_samples=64, keep_prob=1.0,
+                    learning_rate=0.1)
+    defaults.update(kw)
+    return LM1BConfig(**defaults)
+
+
+def build_model(cfg: LM1BConfig, full_softmax: bool = False) -> Model:
+    """``full_softmax=True`` builds the naive dense baseline (loss over the
+    whole vocab, softmax matrix used densely -> classified dense and
+    replicated) — the "stock TF" path the reference benches against."""
+    V = cfg.padded_vocab
+    E, H, P = cfg.emb_dim, cfg.hidden_dim, cfg.proj_dim
+
+    def init_fn(rng):
+        ks = jax.random.split(rng, 6)
+        u = lambda k, shape, s: jax.random.uniform(k, shape, jnp.float32,
+                                                   -s, s)
+        scale = 1.0 / np.sqrt(E)
+        return {
+            "emb": u(ks[0], (V, E), scale),
+            "lstm": {
+                # one fused kernel for [x, h_proj] -> gates
+                "w": u(ks[1], (E + P, 4 * H), 1.0 / np.sqrt(E + P)),
+                "b": jnp.zeros((4 * H,), jnp.float32),
+                "w_proj": u(ks[2], (H, P), 1.0 / np.sqrt(H)),
+            },
+            "softmax_w": u(ks[3], (V, P), 1.0 / np.sqrt(P)),
+            "softmax_b": jnp.zeros((V, 1), jnp.float32),
+        }
+
+    def lstm_scan(lstm, x_seq):
+        """x_seq: [T, B, E] time-major. Returns [T, B, P] projections."""
+        B = x_seq.shape[1]
+        w = lstm["w"].astype(cfg.compute_dtype)
+        b = lstm["b"].astype(cfg.compute_dtype)
+        w_proj = lstm["w_proj"].astype(cfg.compute_dtype)
+
+        def cell(carry, x_t):
+            c, h = carry
+            zx = jnp.concatenate([x_t, h], axis=-1)
+            gates = zx @ w + b
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_full = jax.nn.sigmoid(o) * jnp.tanh(c)
+            h = h_full @ w_proj
+            return (c, h), h
+
+        c0 = jnp.zeros((B, H), cfg.compute_dtype)
+        h0 = jnp.zeros((B, P), cfg.compute_dtype)
+        (_, _), hs = jax.lax.scan(cell, (c0, h0), x_seq)
+        return hs
+
+    def loss_fn(params, batch, rng):
+        x, y = batch["x"], batch["y"]
+        w = batch.get("w")
+        if w is None:
+            w = jnp.ones(x.shape, jnp.float32)
+        B, T = x.shape
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        drop_rng, samp_rng = jax.random.split(rng)
+
+        emb = emb_ops.embedding_lookup(params["emb"], x)       # [B, T, E]
+        emb = emb.astype(cfg.compute_dtype)
+        in_rng, out_rng = jax.random.split(drop_rng)
+        if cfg.keep_prob < 1.0:
+            mask = jax.random.bernoulli(in_rng, cfg.keep_prob, emb.shape)
+            emb = jnp.where(mask, emb / cfg.keep_prob, 0.0)
+
+        hs = lstm_scan(params["lstm"], jnp.swapaxes(emb, 0, 1))  # [T, B, P]
+        if cfg.keep_prob < 1.0:
+            # LSTM-output dropout (reference language_model.py applies
+            # DropoutWrapper output dropout per step; independent masks
+            # per (t, b) position are equivalent).
+            mask = jax.random.bernoulli(out_rng, cfg.keep_prob, hs.shape)
+            hs = jnp.where(mask, hs / cfg.keep_prob, 0.0)
+        hidden = jnp.swapaxes(hs, 0, 1).reshape(B * T, P)
+        hidden = hidden.astype(jnp.float32)
+
+        labels = y.reshape(B * T)
+        if full_softmax:
+            losses = ss_ops.full_softmax_loss(
+                params["softmax_w"], params["softmax_b"], hidden, labels,
+                cfg.vocab_size)                                 # [B*T]
+        else:
+            losses = ss_ops.sampled_softmax_loss(
+                params["softmax_w"], params["softmax_b"], hidden, labels,
+                samp_rng, cfg.num_samples, cfg.vocab_size)      # [B*T]
+        wf = w.reshape(B * T)
+        total_w = jnp.maximum(jnp.sum(wf), 1e-8)
+        loss = jnp.sum(losses * wf) / total_w
+        return loss, {"words": jnp.sum(wf)}
+
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adagrad(cfg.learning_rate, initial_accumulator_value=1.0))
+    return Model(init_fn, loss_fn, optimizer=tx)
+
+
+def build_full_softmax_model(cfg: LM1BConfig) -> Model:
+    return build_model(cfg, full_softmax=True)
+
+
+def make_batch(rng: np.random.Generator, batch_size: int, num_steps: int,
+               vocab_size: int):
+    """Synthetic Zipf-ish batch with the reference driver's feed keys."""
+    x = (rng.zipf(1.3, size=(batch_size, num_steps)) - 1) % vocab_size
+    y = np.roll(x, -1, axis=1)
+    return {"x": x.astype(np.int32), "y": y.astype(np.int32),
+            "w": np.ones((batch_size, num_steps), np.float32)}

@@ -1,0 +1,135 @@
+"""Long-context causal transformer LM — sequence-parallel training.
+
+A new first-class capability over the reference (SURVEY.md §5.7: the
+reference has no sequence parallelism): the sequence dimension of every
+activation is sharded over the mesh's 'shard' axis and attention runs as
+ring attention over the ICI ring (ops/ring_attention.py), so the model
+trains on sequences far longer than one device's memory would allow. The
+batch dimension remains data-parallel over 'repl' — a dp x sp mesh in the
+engine's existing two axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from parallax_tpu.core.engine import Model
+from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD
+from parallax_tpu.ops import embedding as emb_ops
+from parallax_tpu.ops.ring_attention import (full_attention_reference,
+                                             ring_attention)
+
+
+@dataclasses.dataclass
+class LongContextConfig:
+    vocab_size: int = 32000
+    model_dim: int = 512
+    num_heads: int = 8
+    mlp_dim: int = 2048
+    num_layers: int = 6
+    max_len: int = 32768
+    learning_rate: float = 3e-4
+    use_ring_attention: bool = True
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+
+def tiny_config(**kw) -> LongContextConfig:
+    defaults = dict(vocab_size=512, model_dim=32, num_heads=2, mlp_dim=64,
+                    num_layers=2, max_len=64)
+    defaults.update(kw)
+    return LongContextConfig(**defaults)
+
+
+def build_model(cfg: LongContextConfig) -> Model:
+    V, D, Hn = cfg.vocab_size, cfg.model_dim, cfg.num_heads
+    dt = cfg.compute_dtype
+
+    def dense_init(rng, shape):
+        return jax.random.normal(rng, shape) * (1.0 / np.sqrt(shape[0]))
+
+    def init_fn(rng):
+        ks = jax.random.split(rng, 3 + cfg.num_layers)
+        blocks = []
+        for i in range(cfg.num_layers):
+            bk = jax.random.split(ks[2 + i], 6)
+            blocks.append({
+                "wqkv": dense_init(bk[0], (D, 3 * D)),
+                "wo": dense_init(bk[1], (D, D)),
+                "w1": dense_init(bk[2], (D, cfg.mlp_dim)),
+                "w2": dense_init(bk[3], (cfg.mlp_dim, D)),
+                "ln1": {"s": jnp.ones((D,)), "b": jnp.zeros((D,))},
+                "ln2": {"s": jnp.ones((D,)), "b": jnp.zeros((D,))},
+            })
+        return {
+            "emb": jax.random.normal(ks[0], (V, D)) * 0.02,
+            "pos": jax.random.normal(ks[-1], (cfg.max_len, D)) * 0.02,
+            "out_w": dense_init(ks[1], (D, V)),
+            "blocks": blocks,
+        }
+
+    def layer_norm(x, s, b):
+        m = jnp.mean(x, -1, keepdims=True)
+        v = jnp.var(x, -1, keepdims=True)
+        return (x - m) * jax.lax.rsqrt(v + 1e-6) * s + b
+
+    def attention(x, p):
+        B, T, _ = x.shape
+        qkv = x @ p["wqkv"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, -1)
+        q = q.reshape(B, T, Hn, D // Hn)
+        k = k.reshape(B, T, Hn, D // Hn)
+        v = v.reshape(B, T, Hn, D // Hn)
+        mesh = emb_ops.current_mesh()
+        if cfg.use_ring_attention and mesh is not None:
+            out = ring_attention(q, k, v, mesh, AXIS_SHARD,
+                                 causal=True, batch_axis=AXIS_REPL)
+        else:
+            out = full_attention_reference(q, k, v, causal=True)
+        return out.reshape(B, T, D) @ p["wo"].astype(dt)
+
+    def loss_fn(params, batch, rng):
+        ids = batch["ids"]
+        B, T = ids.shape
+        if T > cfg.max_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_len {cfg.max_len}")
+        x = emb_ops.embedding_lookup(params["emb"], ids).astype(dt)
+        x = x + params["pos"][:T].astype(dt)[None]
+        for p in params["blocks"]:
+            ln = p["ln1"]
+            x = x + attention(
+                layer_norm(x, ln["s"].astype(dt), ln["b"].astype(dt)), p)
+            ln = p["ln2"]
+            h = layer_norm(x, ln["s"].astype(dt), ln["b"].astype(dt))
+            x = x + jax.nn.relu(h @ p["w1"].astype(dt)) @ p["w2"].astype(dt)
+        logits = x.astype(jnp.float32) @ params["out_w"]
+        labels = jnp.concatenate(
+            [ids[:, 1:], jnp.zeros((B, 1), ids.dtype)], axis=1)
+        nll = optax.softmax_cross_entropy_with_integer_labels(
+            logits.reshape(B * T, V), labels.reshape(B * T))
+        w = jnp.concatenate(
+            [jnp.ones((B, T - 1)), jnp.zeros((B, 1))], axis=1).reshape(-1)
+        loss = jnp.sum(nll * w) / jnp.sum(w)
+        return loss, {"tokens": jnp.sum(w)}
+
+    # dp over 'repl', sp over 'shard': [batch, seq] inputs
+    batch_specs = {"ids": P(AXIS_REPL, AXIS_SHARD)}
+    return Model(init_fn, loss_fn,
+                 optimizer=optax.chain(optax.clip_by_global_norm(1.0),
+                                       optax.adam(cfg.learning_rate)),
+                 dense_params=("emb",),  # replicated: lookups follow the
+                                         # seq-sharded ids, not vocab rows
+                 batch_specs=batch_specs)
+
+
+def make_batch(rng: np.random.Generator, batch_size: int, seq_len: int,
+               vocab_size: int):
+    return {"ids": rng.integers(1, vocab_size,
+                                (batch_size, seq_len)).astype(np.int32)}

@@ -1,0 +1,40 @@
+"""Linear-regression smoke model.
+
+Port of the reference's de-facto smoke test
+(reference: parallax/parallax/examples/simple/simple_driver.py:93-136):
+a 2-variable linear regression  y_hat = w*x + b  trained with SGD on
+synthetic data from y = 10x - 5 + noise; the driver prints a converging
+loss. Same model, expressed as a parallax_tpu Model.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from parallax_tpu.core.engine import Model
+
+
+def build_model(learning_rate: float = 0.01) -> Model:
+    def init_fn(rng):
+        rw, rb = jax.random.split(rng)
+        return {
+            "w": jax.random.normal(rw, (1,)),
+            "b": jax.random.normal(rb, (1,)),
+        }
+
+    def loss_fn(params, batch):
+        pred = params["w"] * batch["x"] + params["b"]
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, {"w": params["w"][0], "b": params["b"][0]}
+
+    return Model(init_fn, loss_fn, optimizer=optax.sgd(learning_rate))
+
+
+def make_batch(rng: np.random.Generator, batch_size: int):
+    x = rng.standard_normal(batch_size).astype(np.float32)
+    noise = 0.1 * rng.standard_normal(batch_size).astype(np.float32)
+    y = 10.0 * x - 5.0 + noise
+    return {"x": x, "y": y}

@@ -1,0 +1,15 @@
+from parallax_tpu.ops.embedding import (current_mesh, embedding_lookup,
+                                        mask_padded_logits, pad_vocab,
+                                        padded_vocab_for,
+                                        sharded_lookup_scope)
+from parallax_tpu.ops.sampled_softmax import (full_softmax_loss,
+                                              sampled_softmax_loss)
+# NOTE: the ring_attention *function* is deliberately not re-exported
+# here — it would shadow the parallax_tpu.ops.ring_attention submodule
+# attribute. Import it from the submodule:
+#   from parallax_tpu.ops.ring_attention import ring_attention
+from parallax_tpu.ops import ring_attention as _ring_attention_module  # noqa: F401
+
+__all__ = ["embedding_lookup", "pad_vocab", "padded_vocab_for",
+           "mask_padded_logits", "sharded_lookup_scope", "current_mesh",
+           "sampled_softmax_loss", "full_softmax_loss"]

@@ -1,0 +1,220 @@
+"""Row-sharded embedding lookup — the TPU-native parameter server.
+
+The reference keeps sparse variables on parameter-server processes, pulls
+rows over gRPC for the forward pass, and pushes `IndexedSlices` gradients
+into `SparseConditionalAccumulator`s (reference: graph_transform_lib.py
+:330-582, :1041-1211).  On TPU the table lives row-sharded across the
+``'shard'`` mesh axis and the pull/push become ICI collectives:
+
+  forward:  all_gather(ids over 'shard')      — ship indices (tiny, int32)
+            masked local gather               — each shard reads rows it owns
+            psum_scatter(rows over 'shard')   — ship only the looked-up rows
+                                                back to the requesting shard
+  backward: (transpose, derived by AD)
+            all_gather(row grads over 'shard')— ship only touched-row grads
+            masked scatter-add                — each shard accumulates into
+                                                rows it owns; psum over
+                                                'repl' merges replica groups
+
+Bytes on wire per step are O(batch · dim), never O(vocab · dim) — the same
+win the reference's PS path has over dense AllReduce, which is the
+"sparse-grad bytes on wire" north-star metric (BASELINE.json).
+
+``average_duplicates=True`` reproduces the reference fork's
+``SPARSE_AVERAGE_BY_COUNTER`` semantics (graph_transform_lib.py:101-102,
+:385-390): duplicate row updates across the *global* batch are averaged by
+occurrence count instead of summed, implemented as a custom VJP that
+divides the accumulated row gradient by the global row count.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD
+
+
+@dataclasses.dataclass(frozen=True)
+class _MeshCtx:
+    mesh: Mesh
+    sharded_shapes: frozenset  # shapes (tuples) of row-sharded tables
+    average_duplicates: bool
+
+
+_CTX: contextvars.ContextVar[Optional[_MeshCtx]] = contextvars.ContextVar(
+    "parallax_embedding_mesh_ctx", default=None)
+
+
+@contextlib.contextmanager
+def sharded_lookup_scope(mesh: Mesh, sharded_shapes,
+                         average_duplicates: bool = False):
+    """Engine-installed scope: inside it, ``embedding_lookup`` of a table
+    whose shape is registered routes through the sharded collective path."""
+    token = _CTX.set(_MeshCtx(mesh, frozenset(tuple(s) for s in
+                                              sharded_shapes),
+                              average_duplicates))
+    try:
+        yield
+    finally:
+        _CTX.reset(token)
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The mesh installed by the engine for the current trace (None when
+    tracing outside parallel_run, e.g. single-device reference runs).
+    Lets model code reach collectives-aware ops (ring_attention) without
+    threading the mesh through every signature."""
+    ctx = _CTX.get()
+    return ctx.mesh if ctx is not None else None
+
+
+def pad_vocab(vocab_size: int, multiple: int) -> int:
+    """Round vocab up so rows split evenly over shards (XLA wants even
+    splits; the reference's fixed_size_partitioner tolerated ragged ones)."""
+    return -(-vocab_size // multiple) * multiple
+
+
+def padded_vocab_for(vocab_size: int, num_partitions: Optional[int]) -> int:
+    """Shared padding policy for model configs: pad so the table splits
+    evenly over ``num_partitions`` (default: every visible device)."""
+    p = num_partitions or jax.device_count()
+    return pad_vocab(vocab_size, max(p, 1))
+
+
+def mask_padded_logits(logits: jax.Array, vocab_size: int) -> jax.Array:
+    """-inf the phantom classes introduced by vocab padding so they never
+    receive probability mass (last-dim layout [..., padded_vocab])."""
+    padded = logits.shape[-1]
+    if padded == vocab_size:
+        return logits
+    mask = jnp.concatenate(
+        [jnp.zeros((vocab_size,), logits.dtype),
+         jnp.full((padded - vocab_size,), -1e9, logits.dtype)])
+    return logits + mask
+
+
+def embedding_lookup(table: jax.Array, ids: jax.Array,
+                     sharded: Optional[bool] = None) -> jax.Array:
+    """Look up rows of ``table`` (shape [V, D]) at integer ``ids``.
+
+    Outside a `sharded_lookup_scope` (or for tables not registered as
+    sharded) this is a plain gather — the replicated/dense path, equivalent
+    to the reference's MPI mode where every replica holds the full variable.
+    """
+    ctx = _CTX.get()
+    use_sharded = sharded
+    if use_sharded is None:
+        use_sharded = (ctx is not None
+                       and tuple(table.shape) in ctx.sharded_shapes)
+    if not use_sharded or ctx is None or ctx.mesh.shape[AXIS_SHARD] == 1:
+        return jnp.take(table, ids, axis=0)
+    if ctx.average_duplicates:
+        return _sharded_lookup_avg(table, ids, ctx.mesh)
+    return _sharded_lookup(table, ids, ctx.mesh)
+
+
+# --------------------------------------------------------------------------
+# Sum path: plain shard_map; AD transpose gives the scatter-add backward.
+# --------------------------------------------------------------------------
+
+
+def _sharded_lookup(table, ids, mesh):
+    p = mesh.shape[AXIS_SHARD]
+    V, D = table.shape
+    assert V % p == 0, (
+        f"vocab {V} not divisible by shard axis {p}; use pad_vocab()")
+    rows_per_shard = V // p
+    ids_shape = ids.shape
+
+    def local(table_shard, ids_local):
+        # table_shard: [V/p, D]; ids_local: [B/(r·p), ...]
+        flat = ids_local.reshape(-1)
+        ids_all = jax.lax.all_gather(flat, AXIS_SHARD, tiled=True)
+        rows = _masked_local_gather(table_shard, ids_all, rows_per_shard)
+        out = jax.lax.psum_scatter(rows, AXIS_SHARD, scatter_dimension=0,
+                                   tiled=True)
+        return out.reshape(ids_local.shape + (D,))
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(AXIS_SHARD, None), P((AXIS_REPL, AXIS_SHARD))),
+        out_specs=P((AXIS_REPL, AXIS_SHARD)),
+    )(table, ids.reshape(ids_shape))
+
+
+def _masked_local_gather(table_shard, ids_all, rows_per_shard):
+    """Gather rows this shard owns for the gathered global id list; rows
+    owned elsewhere contribute zeros (summed away by psum_scatter)."""
+    lo = jax.lax.axis_index(AXIS_SHARD) * rows_per_shard
+    local_idx = ids_all - lo
+    valid = (local_idx >= 0) & (local_idx < rows_per_shard)
+    safe = jnp.where(valid, local_idx, 0)
+    rows = jnp.take(table_shard, safe, axis=0)
+    return jnp.where(valid[:, None], rows, jnp.zeros_like(rows))
+
+
+# --------------------------------------------------------------------------
+# Average-by-counter path (SPARSE_AVERAGE_BY_COUNTER parity): custom VJP.
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _sharded_lookup_avg_impl(table, ids, mesh):
+    return _sharded_lookup(table, ids, mesh)
+
+
+def _avg_fwd(table, ids, mesh):
+    return _sharded_lookup(table, ids, mesh), (table.shape, ids)
+
+
+def _avg_bwd(mesh, res, g):
+    (V, D), ids = res
+    p = mesh.shape[AXIS_SHARD]
+    rows_per_shard = V // p
+
+    def local(g_local, ids_local):
+        # g_local: [B/(r·p), ..., D]; ids_local: [B/(r·p), ...]
+        g_flat = g_local.reshape(-1, D)
+        ids_flat = ids_local.reshape(-1)
+        g_all = jax.lax.all_gather(g_flat, AXIS_SHARD, tiled=True)
+        ids_all = jax.lax.all_gather(ids_flat, AXIS_SHARD, tiled=True)
+        lo = jax.lax.axis_index(AXIS_SHARD) * rows_per_shard
+        local_idx = ids_all - lo
+        valid = (local_idx >= 0) & (local_idx < rows_per_shard)
+        safe = jnp.where(valid, local_idx, 0)
+        contrib = jnp.zeros((rows_per_shard, D), g_all.dtype)
+        contrib = contrib.at[safe].add(
+            jnp.where(valid[:, None], g_all, jnp.zeros_like(g_all)))
+        counts = jnp.zeros((rows_per_shard,), jnp.float32)
+        counts = counts.at[safe].add(valid.astype(jnp.float32))
+        # Merge replica groups *before* dividing: the counter counts every
+        # contribution in the global batch (reference accumulates across all
+        # workers, then averages once).
+        contrib = jax.lax.psum(contrib, AXIS_REPL)
+        counts = jax.lax.psum(counts, AXIS_REPL)
+        scale = jnp.where(counts > 0, 1.0 / jnp.maximum(counts, 1.0), 0.0)
+        return (contrib * scale[:, None].astype(contrib.dtype))
+
+    grad_table = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P((AXIS_REPL, AXIS_SHARD)), P((AXIS_REPL, AXIS_SHARD))),
+        out_specs=P(AXIS_SHARD, None),
+    )(g, ids)
+    ids_ct = np.zeros(ids.shape, dtype=jax.dtypes.float0)
+    return (grad_table, ids_ct)
+
+
+_sharded_lookup_avg_impl.defvjp(_avg_fwd, _avg_bwd)
+
+
+def _sharded_lookup_avg(table, ids, mesh):
+    return _sharded_lookup_avg_impl(table, ids, mesh)

@@ -15,14 +15,6 @@ all-to-all sequence-parallel cost model.
 
 Differentiable: the ring loop is a `lax.scan` (static trip count =
 ring size), so reverse-mode AD threads the same ring backwards.
-
-TODO(perf, round 2): with contiguous sequence placement, causal masking
-discards ~half the score FLOPs (blocks with kv_origin > idx are fully
-masked) and load is imbalanced across the ring (device 0 does the least
-useful work). The fix is striped/zig-zag placement — each device holds a
-low block and a mirrored high block — which balances causal work; it
-changes the input-layout contract so it lands together with an engine-
-level resharding pass.
 """
 
 from __future__ import annotations

@@ -1,0 +1,108 @@
+"""Embedding partition-count auto-search.
+
+Port of the reference's `PartitionStatCollector` + `get_partitioner`
+(reference: common/partitions.py:35-170) with the same outer loop and the
+same cost model, re-targeted at the TPU mesh:
+
+  * the tunable is the size of the ``'shard'`` mesh axis (how many devices
+    a row-sharded table is split over) instead of a
+    tf.fixed_size_partitioner count;
+  * candidates double from `min_partitions` while step time improves
+    (partitions.py:74-138), snapped to divisors of the device count;
+  * the final pick fits  t(p) = b/p + a·(p-1) + c  and takes the argmin
+    (partitions.py:140-170). The model is linear in (1/p, p-1, 1) so we use
+    a plain least-squares solve — no scipy needed;
+  * trying the next candidate is a re-jit + in-place state reshard, not the
+    reference's full-cluster kill/relaunch.
+
+`get_partitioner` keeps the reference env-var override channel
+(PARALLAX_PARTITIONS / PARALLAX_MIN_PARTITIONS, partitions.py:29-51).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from parallax_tpu.common import consts
+from parallax_tpu.common.lib import parallax_log
+
+
+def get_partitioner(min_partitions: Optional[int] = None) -> int:
+    """Return the embedding partition count a model should build with.
+
+    Reference semantics (partitions.py:35-51): the env override
+    PARALLAX_PARTITIONS (set by the search loop) wins; otherwise
+    ``min_partitions``; otherwise every device. Models use the returned
+    count with ops.embedding.pad_vocab so tables split evenly for any
+    divisor of the device count (letting the search reshard without
+    changing shapes).
+    """
+    env = os.environ.get(consts.PARALLAX_PARTITIONS)
+    if env:
+        return int(env)
+    if min_partitions:
+        return int(min_partitions)
+    return jax.device_count()
+
+
+def divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class PartitionSearch:
+    """Doubling search + curve-fit chooser over shard-axis sizes."""
+
+    def __init__(self, min_partitions: int, num_devices: int):
+        self.num_devices = num_devices
+        self._divs = divisors(num_devices)
+        self.min_p = self._snap(max(1, min_partitions))
+        self.results: List[Tuple[int, float]] = []
+        self._best: Optional[int] = None
+
+    def _snap(self, p: int) -> int:
+        return max(d for d in self._divs if d <= max(p, 1))
+
+    def first_candidate(self) -> int:
+        return self.min_p
+
+    def report(self, p: int, mean_step_time: float) -> Optional[int]:
+        """Record a timing; return the next candidate or None when done."""
+        self.results.append((p, mean_step_time))
+        parallax_log.info("partition search: p=%d mean step %.4fs", p,
+                          mean_step_time)
+        if len(self.results) >= 2 and (self.results[-1][1]
+                                       > self.results[-2][1]):
+            self._fit()
+            return None
+        nxt = self._snap(p * 2)
+        if nxt <= p:  # no larger divisor — search space exhausted
+            self._fit()
+            return None
+        return nxt
+
+    def _fit(self) -> None:
+        pts = sorted(set(self.results))
+        if len(pts) < 3:
+            self._best = min(self.results, key=lambda r: r[1])[0]
+            return
+        ps = np.array([p for p, _ in pts], dtype=np.float64)
+        ts = np.array([t for _, t in pts], dtype=np.float64)
+        basis = np.stack([1.0 / ps, ps - 1.0, np.ones_like(ps)], axis=1)
+        coef, *_ = np.linalg.lstsq(basis, ts, rcond=None)
+        lo, hi = int(ps.min()), self.num_devices
+        cands = [d for d in self._divs if lo <= d <= hi]
+        pred = [coef[0] / d + coef[1] * (d - 1) + coef[2] for d in cands]
+        self._best = cands[int(np.argmin(pred))]
+
+    def best_partitions(self) -> int:
+        if self._best is None:
+            self._fit()
+        return self._best
+
+    @property
+    def done(self) -> bool:
+        return self._best is not None

@@ -1,0 +1,69 @@
+"""Step-bracketed TPU profiling.
+
+Reference: ProfileConfig (config.py:101-117); the patched Session.run forces
+RunOptions(FULL_TRACE) on configured steps and dumps RunMetadata protos to
+profile_dir/<host>/worker:<id>/run_meta/run_meta_<step>
+(session_context.py:74-92, :149-167; lib.py:333-358).
+
+TPU-native: `jax.profiler` traces (XPlane/TensorBoard format) captured on
+the configured steps, one capture per selected host (`profile_worker`
+gating parity — the reference needed it for CUPTI's one-profiler-per-machine
+limit; we keep it so a pod doesn't write N identical traces).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional
+
+import jax
+
+from parallax_tpu.common.config import ProfileConfig
+from parallax_tpu.common.lib import parallax_log
+
+
+class ProfileHook:
+    def __init__(self, config: Optional[ProfileConfig], worker_id: int):
+        self._config = config or ProfileConfig()
+        self._worker_id = worker_id
+        self._tracing = False
+        enabled_worker = (self._config.profile_worker is None
+                          or self._config.profile_worker == worker_id)
+        self._enabled = bool(self._config.profile_dir) and enabled_worker
+
+    @property
+    def active(self) -> bool:
+        return self._tracing
+
+    def _is_profile_step(self, step: int) -> bool:
+        cfg = self._config
+        if cfg.profile_steps and step in cfg.profile_steps:
+            return True
+        if cfg.profile_range:
+            begin, end = cfg.profile_range[0], cfg.profile_range[-1]
+            return begin <= step < end
+        return False
+
+    def _trace_dir(self) -> str:
+        # Layout parity with create_profile_directory (lib.py:333-358).
+        return os.path.join(self._config.profile_dir, socket.gethostname(),
+                            f"worker_{self._worker_id}")
+
+    def before_step(self, step: int) -> None:
+        if not self._enabled or self._tracing:
+            return
+        if self._is_profile_step(step):
+            path = self._trace_dir()
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+            self._tracing = True
+            parallax_log.info("profiling step %d -> %s", step, path)
+
+    def after_step(self, step: int) -> None:
+        if not self._tracing:
+            return
+        # Stop unless the *next* step is also inside a profile range.
+        if not self._is_profile_step(step + 1):
+            jax.profiler.stop_trace()
+            self._tracing = False

@@ -1,0 +1,213 @@
+"""ParallaxSession — the user-facing run loop object.
+
+The reference monkey-patches ``tf.Session.run`` so the user's single-GPU
+feeds/fetches are remapped onto the transformed graph
+(reference: common/session_context.py:35-92, :179-233). Here there is no
+graph to remap: ``run(fetches, feed_dict)`` executes one step of the
+compiled SPMD train step and returns the requested named outputs.
+
+Feed contract parity (session_context.py:205-233): each feed value may be
+  * a single array covering this host's whole local batch, or
+  * a list of ``num_replicas_per_worker`` per-replica arrays (the reference
+    contract) — concatenated on dim 0 before sharding.
+
+Fetch contract: names among {"loss", "global_step"} ∪ the model's metric
+names; a single name returns a scalar, a list returns a list.
+
+The session also owns the per-step hooks the reference installs in the
+patched run: checkpoint triggers (chief-only hooks, lib.py:38-56), profile
+steps (session_context.py:74-92), step timing for the partition search
+(session_context.py:54-71), and — new here — the in-process partition
+re-planning (the reference restarts the whole cluster per candidate;
+we re-jit and reshard in place).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from parallax_tpu.common import consts
+from parallax_tpu.common.config import ParallaxConfig
+from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
+from parallax_tpu.checkpoint import CheckpointHook
+from parallax_tpu.profiler import ProfileHook
+from parallax_tpu.parallel.partitions import PartitionSearch
+
+
+class ParallaxSession:
+    def __init__(self, model: engine_lib.Model, config: ParallaxConfig,
+                 num_workers: int, worker_id: int,
+                 num_replicas_per_worker: int,
+                 num_partitions: Optional[int] = None,
+                 partition_search: Optional[PartitionSearch] = None,
+                 seed: int = 0):
+        self._model = model
+        self._config = config
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.num_replicas_per_worker = num_replicas_per_worker
+        self._seed = seed
+        self._num_partitions = num_partitions
+        self._engine: Optional[engine_lib.Engine] = None
+        self._state = None
+        self._search = partition_search
+        self._step_times: List[float] = []
+        self._ckpt = CheckpointHook(config.ckpt_config, worker_id)
+        self._profile = ProfileHook(config.profile_config, worker_id)
+        self._last_outputs: Dict[str, Any] = {}
+        # Host-side mirror of state.step: reading the device value every
+        # run() would block on the previous step and kill async dispatch.
+        self._host_step = 0
+
+    # -- lazy build (needs the first batch to know shapes) ----------------
+
+    def _ensure_engine(self, batch):
+        if self._engine is not None:
+            return
+        self._build_engine(batch, self._num_partitions)
+        restored = self._ckpt.restore(self._state)
+        if restored is not None:
+            self._state = restored
+            parallax_log.info("restored checkpoint at step %d",
+                              int(self._state.step))
+        self._host_step = int(self._state.step)
+
+    def _build_engine(self, example_batch, num_partitions):
+        mesh = mesh_lib.build_mesh(num_partitions=num_partitions)
+        self._engine = engine_lib.Engine(self._model, mesh, self._config,
+                                         example_batch)
+        if self._state is None:
+            self._state = self._engine.init_state(self._seed)
+        else:
+            # Reshard the live state onto the new plan (partition search);
+            # the reference instead kills and relaunches the cluster
+            # (partitions.py:74-138).
+            self._state = self._reshard_state(self._state)
+
+    def _reshard_state(self, state):
+        """Move the whole live state onto the new mesh. Params take the new
+        plan's shardings; optimizer moments & co. keep their PartitionSpec
+        names re-bound to the new mesh (axis names are stable across
+        plans), so e.g. adam's mu/nu follow their sparse param's new
+        shard count instead of staying on the old mesh."""
+        import jax
+        from jax.sharding import NamedSharding
+        new_mesh = self._engine.mesh
+        new_params = jax.device_put(state.params,
+                                    self._engine._param_shardings)
+
+        def rebind(x):
+            if hasattr(x, "sharding") and isinstance(x.sharding,
+                                                     NamedSharding):
+                return jax.device_put(
+                    x, NamedSharding(new_mesh, x.sharding.spec))
+            return x
+
+        rest = state.replace(params=new_params)
+        return jax.tree.map(rebind, rest)
+
+    # -- the patched-run equivalent ---------------------------------------
+
+    def run(self, fetches: Union[None, str, Sequence[str]] = None,
+            feed_dict: Optional[Dict[str, Any]] = None):
+        if feed_dict is None:
+            raise ValueError(
+                "ParallaxSession.run requires feed_dict (the batch); "
+                "fetch-only runs have no meaning under SPMD")
+        batch = self._convert_feed(feed_dict)
+        self._ensure_engine(batch)
+
+        step = self._host_step
+        self._profile.before_step(step)
+        t0 = time.perf_counter()
+        self._state, outputs = self._engine.step(self._state, batch)
+        if self._search is not None or self._profile.active:
+            # Block so step timing / traces cover real device work.
+            outputs = {k: np.asarray(v) for k, v in outputs.items()}
+        dt = time.perf_counter() - t0
+        self._profile.after_step(step)
+        self._last_outputs = outputs
+        new_step = step + 1
+        self._host_step = new_step
+        self._ckpt.maybe_save(new_step, self._state)
+        if self._search is not None:
+            self._record_search_time(dt)
+        return self._convert_fetch(fetches, outputs)
+
+    @property
+    def state(self):
+        return self._state
+
+    @property
+    def engine(self):
+        return self._engine
+
+    # -- partition search (reference: common/partitions.py) ---------------
+
+    def _record_search_time(self, dt: float) -> None:
+        self._step_times.append(dt)
+        warm = consts.NUM_ITERATIONS_FOR_WARMUP
+        test = consts.NUM_ITERATIONS_FOR_TEST
+        if len(self._step_times) < test:
+            return
+        mean_t = float(np.mean(self._step_times[warm:test]))
+        self._step_times = []
+        nxt = self._search.report(mesh_lib.num_shards(self._engine.mesh),
+                                  mean_t)
+        if nxt is None:
+            best = self._search.best_partitions()
+            parallax_log.info(
+                "partition search done: best num_partitions=%d", best)
+            self._search = None
+            if best != mesh_lib.num_shards(self._engine.mesh):
+                self._build_engine_from_live(best)
+        else:
+            parallax_log.info("partition search: trying p=%d", nxt)
+            self._build_engine_from_live(nxt)
+
+    def _build_engine_from_live(self, p: int) -> None:
+        example = self._last_example_batch
+        self._build_engine(example, p)
+
+    # -- feed/fetch conversion (session_context.py:179-233 parity) --------
+
+    def _convert_feed(self, feed_dict):
+        batch = {}
+        for name, value in feed_dict.items():
+            if isinstance(value, (list, tuple)):
+                if len(value) != self.num_replicas_per_worker:
+                    raise ValueError(
+                        f"feed {name!r}: got a list of {len(value)} arrays "
+                        f"but num_replicas_per_worker="
+                        f"{self.num_replicas_per_worker} (reference "
+                        f"contract: one array per local replica)")
+                value = np.concatenate([np.asarray(v) for v in value],
+                                       axis=0)
+            batch[name] = np.asarray(value)
+        self._last_example_batch = batch
+        return batch
+
+    def _convert_fetch(self, fetches, outputs):
+        if fetches is None:
+            return {k: _to_host(v) for k, v in outputs.items()}
+        if isinstance(fetches, str):
+            return _to_host(self._one(fetches, outputs))
+        return [_to_host(self._one(f, outputs)) for f in fetches]
+
+    def _one(self, name, outputs):
+        if name not in outputs:
+            raise KeyError(
+                f"fetch {name!r} unknown; available: {sorted(outputs)}")
+        return outputs[name]
+
+    def close(self):
+        self._ckpt.close()
+
+
+def _to_host(v):
+    arr = np.asarray(v)
+    return arr.item() if arr.ndim == 0 else arr

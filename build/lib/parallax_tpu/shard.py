@@ -1,0 +1,55 @@
+"""Input-data sharding API.
+
+Reference: common/shard.py — ``shard.shard(ds)`` appends an
+``_enumerate().filter(i % num_shards == shard_id)`` stage to a tf.data
+pipeline (:69-87) and ``create_num_shards_and_shard_id()`` registers
+graph constants that the per-worker transform rewrites (:26-54,
+graph_transform_lib.py:707-773).
+
+TPU-native: there is no graph to rewrite — the shard parameters are plain
+process-level values (num_shards = number of host processes, shard_id =
+this process's index), installed by `parallel_run`. `shard()` keeps the
+exact mod-filter semantics over any python iterable; models that shard at
+the *file* level call `create_num_shards_and_shard_id()` (skip_thoughts
+pattern, reference skip_thoughts/ops/input_ops.py:92-101).
+
+Within a host, no further splitting is needed: the session shards each fed
+batch across local devices on dim 0 (the in-graph-replication equivalent).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional, Tuple, TypeVar
+
+T = TypeVar("T")
+
+_num_shards: int = 1
+_shard_id: int = 0
+_initialized: bool = False
+
+
+def _install(num_shards: int, shard_id: int) -> None:
+    """Called by parallel_run (the update_shard_values_for_worker
+    equivalent, graph_transform_lib.py:707-773)."""
+    global _num_shards, _shard_id, _initialized
+    if not 0 <= shard_id < num_shards:
+        raise ValueError(f"shard_id {shard_id} not in [0, {num_shards})")
+    _num_shards, _shard_id, _initialized = num_shards, shard_id, True
+
+
+def create_num_shards_and_shard_id() -> Tuple[int, int]:
+    """Return (num_shards, shard_id) for file-level sharding
+    (reference shard.py:26-54)."""
+    return _num_shards, _shard_id
+
+
+def shard(dataset: Iterable[T],
+          num_shards: Optional[int] = None,
+          shard_id: Optional[int] = None) -> Iterator[T]:
+    """Yield only this worker's elements: index % num_shards == shard_id
+    (reference shard.py:69-87)."""
+    n = _num_shards if num_shards is None else num_shards
+    s = _shard_id if shard_id is None else shard_id
+    for i, elem in enumerate(dataset):
+        if i % n == s:
+            yield elem

@@ -35,6 +35,9 @@ def main():
     ap.add_argument("--data_path", default=None,
                     help="int32 token file (parallax_tpu.data format); "
                          "default: synthetic Zipf stream")
+    ap.add_argument("--ckpt_dir", default=None)
+    ap.add_argument("--save_ckpt_steps", type=int, default=None)
+    ap.add_argument("--save_ckpt_secs", type=float, default=None)
     ap.add_argument("--partitions", type=int, default=None,
                     help="embedding partitions (reference "
                          "get_partitioner(32)); default auto")
@@ -46,9 +49,14 @@ def main():
         hidden_dim=args.hidden_dim, proj_dim=args.proj_dim,
         num_samples=args.num_samples, num_partitions=num_partitions)
     model = lm1b.build_model(cfg)
+    config = parallax.Config(
+        run_option=args.run_option,
+        ckpt_config=parallax.CheckPointConfig(
+            ckpt_dir=args.ckpt_dir,
+            save_ckpt_steps=args.save_ckpt_steps,
+            save_ckpt_secs=args.save_ckpt_secs))
     sess, num_workers, worker_id, num_replicas = parallax.parallel_run(
-        model, args.resource_info,
-        parallax_config=parallax.Config(run_option=args.run_option),
+        model, args.resource_info, parallax_config=config,
         num_partitions=num_partitions)
     print(f"workers={num_workers} replicas={num_replicas} "
           f"padded_vocab={cfg.padded_vocab}")

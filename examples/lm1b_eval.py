@@ -1,0 +1,94 @@
+"""LM1B evaluation: restore a checkpoint, report full-softmax perplexity.
+
+Parity with the reference's eval flow (reference: examples/lm1b/
+lm1b_eval.py — separate script restoring the training checkpoint and
+evaluating with the exact softmax instead of the sampled one).
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import parallax_tpu as parallax
+from parallax_tpu.models import lm1b
+from parallax_tpu.ops import sampled_softmax as ss_ops
+
+
+def restore_params(ckpt_dir: str, cfg: lm1b.LM1BConfig):
+    """Restore the latest training checkpoint's params pytree."""
+    import orbax.checkpoint as ocp
+    import os
+    mngr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
+    latest = mngr.latest_step()
+    if latest is None:
+        raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+    model = lm1b.build_model(cfg)
+    params, _ = model.call_init(jax.random.PRNGKey(0))
+    opt_state = model.optimizer.init(params)
+    template = parallax.TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state,
+        rng=jax.random.PRNGKey(0), model_state=None)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template)
+    restored = mngr.restore(latest, args=ocp.args.StandardRestore(abstract))
+    mngr.close()
+    return restored.params, latest
+
+
+def evaluate(params, cfg: lm1b.LM1BConfig, batches) -> float:
+    """Mean full-softmax perplexity over an iterable of (x, y, w)."""
+    eval_model = lm1b.build_model(cfg, full_softmax=True)
+
+    @jax.jit
+    def batch_nll(params, batch):
+        loss, metrics, _ = eval_model.call_loss(
+            params, batch, jax.random.PRNGKey(0))
+        return loss, metrics["words"]
+
+    total_nll, total_w = 0.0, 0.0
+    for batch in batches:
+        loss, words = batch_nll(params, batch)
+        total_nll += float(loss) * float(words)
+        total_w += float(words)
+    return float(np.exp(total_nll / max(total_w, 1.0)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt_dir", required=True)
+    ap.add_argument("--vocab_size", type=int, default=793470)
+    ap.add_argument("--emb_dim", type=int, default=512)
+    ap.add_argument("--hidden_dim", type=int, default=2048)
+    ap.add_argument("--proj_dim", type=int, default=512)
+    ap.add_argument("--partitions", type=int, default=None)
+    ap.add_argument("--batch_size", type=int, default=16)
+    ap.add_argument("--num_steps", type=int, default=20)
+    ap.add_argument("--eval_batches", type=int, default=20)
+    ap.add_argument("--data_path", default=None)
+    args = ap.parse_args()
+
+    cfg = lm1b.LM1BConfig(
+        vocab_size=args.vocab_size, emb_dim=args.emb_dim,
+        hidden_dim=args.hidden_dim, proj_dim=args.proj_dim,
+        num_partitions=parallax.get_partitioner(args.partitions),
+        keep_prob=1.0)
+    params, step = restore_params(args.ckpt_dir, cfg)
+    print(f"restored step {step}")
+
+    if args.data_path:
+        from parallax_tpu.data import TokenDataset
+        ds = TokenDataset(args.data_path, args.batch_size, args.num_steps)
+        batches = [ds.next_batch() for _ in range(args.eval_batches)]
+    else:
+        rng = np.random.default_rng(123)
+        batches = [lm1b.make_batch(rng, args.batch_size, args.num_steps,
+                                   cfg.vocab_size)
+                   for _ in range(args.eval_batches)]
+    ppl = evaluate(params, cfg, batches)
+    print(f"eval perplexity: {ppl:.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""BERT pretraining (MLM+NSP) through the hybrid engine."""
+
+import numpy as np
+
+import parallax_tpu as parallax
+from parallax_tpu.models import bert
+
+
+def test_classification_and_training(rng):
+    cfg = bert.tiny_config(num_partitions=8, learning_rate=1e-3)
+    model = bert.build_model(cfg)
+    sess, *_ = parallax.parallel_run(
+        model, parallax_config=parallax.Config(run_option="HYBRID",
+                                               search_partitions=False))
+    batches = [bert.make_batch(rng, 16, 16, 4, cfg.vocab_size)
+               for _ in range(2)]
+    out = sess.run(None, feed_dict=batches[0])
+    specs = sess.engine.plan.var_specs
+    assert specs["word_emb"].is_sparse
+    assert not specs["type_emb"].is_sparse     # user override
+    assert not specs["mlm/out"].is_sparse      # dense MLM head
+    assert not sess.state.params["word_emb"].sharding.is_fully_replicated
+    assert out["masked_tokens"] == 16 * 4
+
+    first = out["loss"]
+    for i in range(40):
+        last = sess.run("loss", feed_dict=batches[i % 2])
+    assert last < first * 0.9, (first, last)
+    assert np.isfinite(last)
+    sess.close()

@@ -1,0 +1,42 @@
+"""Train -> checkpoint -> restore -> full-softmax eval round trip
+(reference lm1b_eval.py flow)."""
+
+import sys
+
+import numpy as np
+import pytest
+
+import parallax_tpu as parallax
+from parallax_tpu.models import lm1b
+
+sys.path.insert(0, "examples")
+
+
+def test_train_ckpt_eval_roundtrip(tmp_path, rng):
+    from lm1b_eval import evaluate, restore_params
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg = lm1b.tiny_config(num_partitions=8, learning_rate=0.5)
+    model = lm1b.build_model(cfg)
+    sess, *_ = parallax.parallel_run(
+        model,
+        parallax_config=parallax.Config(
+            run_option="HYBRID", search_partitions=False,
+            ckpt_config=parallax.CheckPointConfig(ckpt_dir=ckpt_dir,
+                                                  save_ckpt_steps=10)))
+    batches = [lm1b.make_batch(rng, 16, 8, cfg.vocab_size)
+               for _ in range(4)]
+    for i in range(40):
+        sess.run("loss", feed_dict=batches[i % 4])
+    sess.close()
+
+    params, step = restore_params(ckpt_dir, cfg)
+    assert step == 40
+    ppl_trained = evaluate(params, cfg, batches)
+
+    init_params, _ = lm1b.build_model(cfg).call_init(
+        __import__("jax").random.PRNGKey(0))
+    ppl_init = evaluate(init_params, cfg, batches)
+    assert np.isfinite(ppl_trained)
+    # training on repeated batches must beat the random-init perplexity
+    assert ppl_trained < ppl_init * 0.7, (ppl_init, ppl_trained)

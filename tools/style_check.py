@@ -1,0 +1,27 @@
+"""Style gate.
+
+Parity with the reference's tools/style_check.py:22-27 (pycodestyle over
+the core, excluding examples). pycodestyle may not be installed in every
+image, so fall back to python's compileall as a syntax gate.
+"""
+
+import subprocess
+import sys
+
+
+def main() -> int:
+    targets = ["parallax_tpu", "tests", "bench.py", "__graft_entry__.py"]
+    try:
+        import pycodestyle  # noqa: F401
+        rc = subprocess.call(
+            [sys.executable, "-m", "pycodestyle",
+             "--max-line-length=100", *targets])
+    except ImportError:
+        print("pycodestyle not installed; running syntax check only")
+        rc = subprocess.call(
+            [sys.executable, "-m", "compileall", "-q", *targets])
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
