@@ -72,7 +72,8 @@ class Model:
                  sparse_params: Sequence[str] = (),
                  dense_params: Sequence[str] = (),
                  stateful: bool = False,
-                 batch_specs: Optional[Dict[str, Any]] = None):
+                 batch_specs: Optional[Dict[str, Any]] = None,
+                 param_specs: Optional[Dict[str, Any]] = None):
         self.init_fn = init_fn
         self.loss_fn = loss_fn
         self.optimizer = optimizer or optax.sgd(0.01)
@@ -82,6 +83,10 @@ class Model:
         # feed name -> PartitionSpec override (e.g. sequence-parallel
         # inputs sharded P('repl', 'shard') on [batch, seq])
         self.batch_specs = dict(batch_specs or {})
+        # param path pattern (fnmatch) -> PartitionSpec override, for
+        # layouts the dense/sparse classifier can't infer (e.g. expert
+        # weights sharded P('shard', None, None), tensor-parallel kernels)
+        self.param_specs = dict(param_specs or {})
         try:
             n_pos = len([
                 p for p in inspect.signature(loss_fn).parameters.values()
@@ -180,7 +185,23 @@ def build_plan(model: Model, mesh: Mesh, config: ParallaxConfig,
                 shape[:1], p)
         return mesh_lib.replicated_spec()
 
-    pspecs_flat = [choose(path, leaf)
+    import fnmatch
+
+    def with_override(path, leaf, spec):
+        for pattern, override in model.param_specs.items():
+            if fnmatch.fnmatch(path, pattern):
+                bad = spec_shape_mismatch(override, leaf.shape, mesh)
+                if bad is not None:
+                    dim, axes, size = bad
+                    parallax_log.warning(
+                        "param_specs override for %s: dim %d (%d) "
+                        "not divisible by %s (%d); replicating",
+                        path, dim, leaf.shape[dim], axes, size)
+                    return spec
+                return override
+        return spec
+
+    pspecs_flat = [with_override(path, leaf, choose(path, leaf))
                    for path, (_, leaf) in zip(paths, flat)]
     param_pspecs = jax.tree_util.tree_unflatten(treedef, pspecs_flat)
 
@@ -305,29 +326,38 @@ class Engine:
         n = mesh_lib.num_devices(self.mesh)
         overrides = self.model.batch_specs
 
+        multiprocess = jax.process_count() > 1
+
+        def place(x, sharding):
+            if multiprocess:
+                # each host feeds its local slice of the global batch
+                # (reference: each worker's shard, shard.py semantics)
+                return jax.make_array_from_process_local_data(sharding, x)
+            return jax.device_put(x, sharding)
+
         def put(name, x):
             x = np.asarray(x)
             if name in overrides:
                 spec = overrides[name]
-                for dim, axes in enumerate(spec):
-                    if axes is None:
-                        continue
-                    axes = (axes,) if isinstance(axes, str) else axes
-                    need = int(np.prod([self.mesh.shape[a] for a in axes]))
-                    if dim < x.ndim and x.shape[dim] % need != 0:
-                        raise ValueError(
-                            f"feed {name!r} dim {dim} of size "
-                            f"{x.shape[dim]} is not divisible by the "
-                            f"{need}-way mesh axes {axes} in its "
-                            f"PartitionSpec; pad that dimension")
-                return jax.device_put(
-                    x, NamedSharding(self.mesh, spec))
-            if x.ndim >= 1 and x.shape[0] % n != 0:
+                # in multiprocess mode the caller feeds a process-local
+                # slice, so each dim's requirement shrinks accordingly
+                bad = spec_shape_mismatch(spec, x.shape, self.mesh,
+                                          jax.process_count())
+                if bad is not None:
+                    dim, axes, need = bad
+                    raise ValueError(
+                        f"feed {name!r} dim {dim} of size "
+                        f"{x.shape[dim]} is not divisible by the "
+                        f"{need}-way (local) mesh axes {axes} in its "
+                        f"PartitionSpec; pad that dimension")
+                return place(x, NamedSharding(self.mesh, spec))
+            local_n = max(1, n // jax.process_count())
+            if x.ndim >= 1 and x.shape[0] % local_n != 0:
                 raise ValueError(
                     f"batch dimension {x.shape[0]} is not divisible by the "
-                    f"{n} devices of the mesh; pad the global batch (or "
-                    f"feed per-replica lists of equal size)")
-            return jax.device_put(x, self.batch_sharding_fn(x.ndim))
+                    f"{local_n} local devices of the mesh; pad the batch "
+                    f"(or feed per-replica lists of equal size)")
+            return place(x, self.batch_sharding_fn(x.ndim))
 
         if isinstance(batch, dict):
             return {k: jax.tree.map(lambda x, k=k: put(k, x), v)
@@ -350,6 +380,22 @@ class Engine:
             parallax_log.info("exported compiled graph to %s", path)
         except Exception as e:  # non-fatal observability feature
             parallax_log.warning("graph export failed: %s", e)
+
+
+def spec_shape_mismatch(spec, shape, mesh, num_processes: int = 1):
+    """Check a PartitionSpec against an array shape: every constrained dim
+    must divide the product of its mesh axes (divided by ``num_processes``
+    when validating a process-local slice of a global array). Returns
+    (dim, axes, required) for the first violation, or None."""
+    for dim, axes in enumerate(spec):
+        if axes is None or dim >= len(shape):
+            continue
+        axes = (axes,) if isinstance(axes, str) else tuple(axes)
+        size = int(np.prod([mesh.shape[a] for a in axes]))
+        size = max(1, size // num_processes)
+        if shape[dim] % size != 0:
+            return dim, axes, size
+    return None
 
 
 def _dtype_of(x):

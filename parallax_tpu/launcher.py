@@ -33,8 +33,9 @@ def launch_workers(hosts: Sequence[HostInfo],
 
     Returns the chief's exit code.
     """
-    coordinator = (f"{hosts[0].hostname}:"
-                   f"{consts.PARALLAX_COORDINATOR_PORT_DEFAULT}")
+    port = os.environ.get("PARALLAX_COORDINATOR_PORT",
+                          consts.PARALLAX_COORDINATOR_PORT_DEFAULT)
+    coordinator = f"{hosts[0].hostname}:{port}"
     serialized = serialize_resource_info(hosts)
     cmd = (_shell_quote(sys.executable) + " "
            + " ".join(_shell_quote(a) for a in sys.argv))

@@ -1,0 +1,146 @@
+"""Mixture-of-experts transformer LM — expert parallelism end-to-end.
+
+Expert parallelism is a TPU-native extension beyond the reference
+(SURVEY.md §2.5 lists EP as absent). Every block's MLP is a top-1 switch
+MoE (ops/moe.py): expert weights shard over the 'shard' mesh axis via
+Model.param_specs overrides, tokens dispatch/combine with all_to_all,
+and the router's load-balancing auxiliary loss joins the objective.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from parallax_tpu.core.engine import Model
+from parallax_tpu.core.mesh import AXIS_SHARD
+from parallax_tpu.ops import embedding as emb_ops
+from parallax_tpu.ops import moe as moe_ops
+from parallax_tpu.ops.ring_attention import full_attention_reference
+
+
+@dataclasses.dataclass
+class MoeLMConfig:
+    vocab_size: int = 32000
+    model_dim: int = 512
+    num_heads: int = 8
+    expert_dim: int = 1024
+    num_experts: int = 16
+    num_layers: int = 6
+    max_len: int = 1024
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    learning_rate: float = 3e-4
+    num_partitions: Optional[int] = None
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def padded_vocab(self) -> int:
+        return emb_ops.padded_vocab_for(self.vocab_size,
+                                        self.num_partitions)
+
+
+def tiny_config(**kw) -> MoeLMConfig:
+    defaults = dict(vocab_size=512, model_dim=32, num_heads=2,
+                    expert_dim=64, num_experts=8, num_layers=2,
+                    max_len=32)
+    defaults.update(kw)
+    return MoeLMConfig(**defaults)
+
+
+def build_model(cfg: MoeLMConfig) -> Model:
+    V, D, E, F = (cfg.padded_vocab, cfg.model_dim, cfg.num_experts,
+                  cfg.expert_dim)
+    dt = cfg.compute_dtype
+
+    def dense_init(rng, shape, axis=0):
+        return jax.random.normal(rng, shape) * (1.0 / np.sqrt(shape[axis]))
+
+    def init_fn(rng):
+        ks = jax.random.split(rng, 3 + cfg.num_layers)
+        blocks = []
+        for i in range(cfg.num_layers):
+            bk = jax.random.split(ks[3 + i], 5)
+            blocks.append({
+                "wqkv": dense_init(bk[0], (D, 3 * D)),
+                "wo": dense_init(bk[1], (D, D)),
+                "router": dense_init(bk[2], (D, E)),
+                "moe_w1": dense_init(bk[3], (E, D, F), axis=1),
+                "moe_w2": dense_init(bk[4], (E, F, D), axis=1),
+                "ln1": {"s": jnp.ones((D,)), "b": jnp.zeros((D,))},
+                "ln2": {"s": jnp.ones((D,)), "b": jnp.zeros((D,))},
+            })
+        return {
+            "emb": jax.random.normal(ks[0], (V, D)) * 0.02,
+            "pos": jax.random.normal(ks[1], (cfg.max_len, D)) * 0.02,
+            "out_w": dense_init(ks[2], (D, V)),
+            "blocks": blocks,
+        }
+
+    def layer_norm(x, p):
+        m = jnp.mean(x, -1, keepdims=True)
+        v = jnp.var(x, -1, keepdims=True)
+        return ((x - m) * jax.lax.rsqrt(v + 1e-6) * p["s"].astype(x.dtype)
+                + p["b"].astype(x.dtype))
+
+    def attention(x, p):
+        B, T, _ = x.shape
+        q, k, v = jnp.split(x @ p["wqkv"].astype(dt), 3, -1)
+        Hn = cfg.num_heads
+
+        def heads(z):
+            return z.reshape(B, T, Hn, D // Hn)
+
+        out = full_attention_reference(heads(q), heads(k), heads(v),
+                                       causal=True)
+        return out.reshape(B, T, D) @ p["wo"].astype(dt)
+
+    def loss_fn(params, batch, rng):
+        ids = batch["ids"]
+        B, T = ids.shape
+        mesh = emb_ops.current_mesh()
+        x = emb_ops.embedding_lookup(params["emb"], ids).astype(dt)
+        x = x + params["pos"][:T].astype(dt)[None]
+        aux_total = 0.0
+        for p in params["blocks"]:
+            x = layer_norm(x + attention(x, p), p["ln1"])
+            tokens = x.reshape(B * T, D)
+            moe_out, aux = moe_ops.switch_moe(
+                tokens, p["router"], p["moe_w1"], p["moe_w2"], mesh,
+                cfg.capacity_factor)
+            aux_total = aux_total + aux
+            x = layer_norm(x + moe_out.reshape(B, T, D).astype(dt),
+                           p["ln2"])
+        logits = x.astype(jnp.float32) @ params["out_w"]
+        logits = emb_ops.mask_padded_logits(logits, cfg.vocab_size)
+        labels = jnp.concatenate(
+            [ids[:, 1:], jnp.zeros((B, 1), ids.dtype)], axis=1)
+        w = jnp.concatenate(
+            [jnp.ones((B, T - 1)), jnp.zeros((B, 1))], axis=1).reshape(-1)
+        nll = optax.softmax_cross_entropy_with_integer_labels(
+            logits.reshape(B * T, V), labels.reshape(B * T))
+        lm_loss = jnp.sum(nll * w) / jnp.sum(w)
+        aux_mean = aux_total / cfg.num_layers
+        loss = lm_loss + cfg.aux_loss_weight * aux_mean
+        return loss, {"lm_loss": lm_loss, "aux_loss": aux_mean}
+
+    tx = optax.chain(optax.clip_by_global_norm(1.0),
+                     optax.adam(cfg.learning_rate))
+    return Model(
+        init_fn, loss_fn, optimizer=tx,
+        param_specs={
+            "blocks/*/moe_w1": P(AXIS_SHARD, None, None),
+            "blocks/*/moe_w2": P(AXIS_SHARD, None, None),
+        })
+
+
+def make_batch(rng: np.random.Generator, batch_size: int, seq_len: int,
+               vocab_size: int):
+    return {"ids": rng.integers(1, vocab_size,
+                                (batch_size, seq_len)).astype(np.int32)}

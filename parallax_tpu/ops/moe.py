@@ -1,0 +1,131 @@
+"""Mixture-of-experts layer with expert parallelism over the mesh.
+
+Expert parallelism is absent from the reference (SURVEY.md §2.5) — this is
+a TPU-native extension rounding out the parallelism inventory: experts are
+sharded over the ``'shard'`` mesh axis (one group of experts per device
+slice) and tokens are routed to their experts with a capacity-bounded
+``all_to_all`` dispatch/combine, the standard TPU MoE shape (static
+shapes, no dynamic-size tensors under jit).
+
+Layout:
+  * expert weights: [E, D, F] sharded P('shard', None, None) — each
+    device holds E/n experts;
+  * tokens: [G, C, D] where G = groups (= data shards), C = capacity —
+    dispatched via all_to_all over the expert axis;
+  * router: dense [D, E], replicated, top-1 (switch) routing with an
+    auxiliary load-balancing loss (Shazeer et al.).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD
+
+
+def switch_moe(tokens: jax.Array,          # [B, D] (batch sharded dim 0)
+               router_w: jax.Array,        # [D, E] replicated
+               expert_w1: jax.Array,       # [E, D, F] row(expert)-sharded
+               expert_w2: jax.Array,       # [E, F, D] row(expert)-sharded
+               mesh: Optional[Mesh],
+               capacity_factor: float = 1.25,
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Top-1 (switch) MoE. Returns (outputs [B, D], aux_loss scalar).
+
+    Without a mesh (single device / reference path) the same math runs
+    unsharded; with a mesh the experts are sharded over 'shard' and
+    dispatch/combine run as all_to_all over that axis.
+    """
+    B, D = tokens.shape
+    E = router_w.shape[1]
+
+    logits = tokens.astype(jnp.float32) @ router_w    # [B, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)           # [B]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+
+    # load-balancing auxiliary loss: E * sum_e f_e * p_e
+    density = jnp.mean(jax.nn.one_hot(expert_idx, E), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(density * mean_prob)
+
+    n = mesh.shape[AXIS_SHARD] if mesh is not None else 1
+    if mesh is None or n == 1 or E % n != 0:
+        if mesh is not None and n > 1 and E % n != 0:
+            # mirrors the engine's param_specs graceful fallback: an
+            # indivisible expert count runs the replicated dense path
+            from parallax_tpu.common.lib import parallax_log
+            parallax_log.warning(
+                "switch_moe: %d experts not divisible by shard axis %d; "
+                "running the replicated (non-EP) path", E, n)
+        out = _expert_compute_dense(tokens, expert_idx, gate, expert_w1,
+                                    expert_w2)
+        return out, aux_loss
+    # capacity is per (device, expert) dispatch slots: balanced load puts
+    # local_b / E tokens on each expert per device
+    local_b = B // int(np.prod(list(mesh.shape.values())))
+    capacity = max(1, int(np.ceil(capacity_factor * local_b / E)))
+
+    def local(tokens_l, idx_l, gate_l, w1_l, w2_l):
+        # tokens_l: [b, D]; w1_l: [E/n, D, F]
+        b = tokens_l.shape[0]
+        e_per = E // n
+        # position of each token within its expert's capacity buffer
+        onehot = jax.nn.one_hot(idx_l, E, dtype=jnp.int32)     # [b, E]
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1          # [b, E]
+        pos_in_expert = jnp.max(pos, axis=1)                   # [b]
+        keep = pos_in_expert < capacity
+        # dispatch buffer: [E, capacity, D]
+        disp = jnp.zeros((E, capacity, D), tokens_l.dtype)
+        safe_pos = jnp.where(keep, pos_in_expert, 0)
+        disp = disp.at[idx_l, safe_pos].add(
+            jnp.where(keep[:, None], tokens_l, 0))
+        # ship each expert group to its owner shard: regroup [E, C, D] as
+        # [n, e_per, C, D] (dim0 = owner shard), exchange chunks; after
+        # the all_to_all, recv[s'] holds peer s' tokens for MY experts
+        disp = disp.reshape(n, e_per, capacity, D)
+        recv = jax.lax.all_to_all(disp, AXIS_SHARD, split_axis=0,
+                                  concat_axis=0, tiled=True)
+        # [n, e_per, C, D] -> per-expert token matrix [e_per, n*C, D]
+        x_e = recv.transpose(1, 0, 2, 3).reshape(e_per, n * capacity, D)
+        h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", x_e,
+                                   w1_l.astype(x_e.dtype)))
+        y_e = jnp.einsum("ecf,efd->ecd", h, w2_l.astype(x_e.dtype))
+        # route results back to the shards that own the tokens
+        back = y_e.reshape(e_per, n, capacity, D).transpose(1, 0, 2, 3)
+        out = jax.lax.all_to_all(back, AXIS_SHARD, split_axis=0,
+                                 concat_axis=0, tiled=True)
+        # out[s', j] = my tokens' outputs from expert (s', j)
+        out = out.reshape(E, capacity, D)
+        # combine: each token reads its slot
+        combined = out[idx_l, safe_pos]                        # [b, D]
+        combined = jnp.where(keep[:, None], combined, 0)
+        return combined * gate_l[:, None].astype(combined.dtype)
+
+    out = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P((AXIS_REPL, AXIS_SHARD), None),
+                  P((AXIS_REPL, AXIS_SHARD)),
+                  P((AXIS_REPL, AXIS_SHARD)),
+                  P(AXIS_SHARD, None, None),
+                  P(AXIS_SHARD, None, None)),
+        out_specs=P((AXIS_REPL, AXIS_SHARD), None),
+    )(tokens, expert_idx, gate, expert_w1, expert_w2)
+    return out, aux_loss
+
+
+def _expert_compute_dense(tokens, expert_idx, gate, w1, w2):
+    """Unsharded reference path: every expert computed for its tokens via
+    one-hot masking (small E)."""
+    h = jnp.einsum("bd,edf->bef", tokens, w1.astype(tokens.dtype))
+    h = jax.nn.relu(h)
+    out_all = jnp.einsum("bef,efd->bed", h, w2.astype(tokens.dtype))
+    sel = jax.nn.one_hot(expert_idx, w1.shape[0],
+                         dtype=tokens.dtype)                  # [B, E]
+    out = jnp.einsum("bed,be->bd", out_all, sel)
+    return out * gate[:, None].astype(out.dtype)

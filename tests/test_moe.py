@@ -1,0 +1,90 @@
+"""Expert-parallel MoE tests: sharded dispatch/combine matches the
+unsharded reference path; gradients flow; capacity drops are bounded."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallax_tpu.core import mesh as mesh_lib
+from parallax_tpu.ops import moe
+
+
+B, D, F, E = 64, 16, 32, 8
+
+
+@pytest.fixture
+def weights(rng):
+    return (
+        jnp.asarray(rng.standard_normal((D, E)).astype(np.float32)) * 0.5,
+        jnp.asarray(rng.standard_normal((E, D, F)).astype(np.float32))
+        * 0.1,
+        jnp.asarray(rng.standard_normal((E, F, D)).astype(np.float32))
+        * 0.1,
+    )
+
+
+@pytest.fixture
+def tokens(rng):
+    return jnp.asarray(rng.standard_normal((B, D)).astype(np.float32))
+
+
+@pytest.mark.parametrize("p", [2, 4, 8])
+def test_sharded_matches_dense_path(tokens, weights, p):
+    router, w1, w2 = weights
+    mesh = mesh_lib.build_mesh(num_partitions=p)
+    # generous capacity so nothing is dropped -> exact match
+    ref, aux_ref = moe.switch_moe(tokens, router, w1, w2, None,
+                                  capacity_factor=float(E))
+    got, aux = moe.switch_moe(tokens, router, w1, w2, mesh,
+                              capacity_factor=float(E))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-6)
+
+
+def test_gradients_flow_through_dispatch(tokens, weights):
+    router, w1, w2 = weights
+    mesh = mesh_lib.build_mesh(num_partitions=4)
+
+    def loss(w1, w2, tokens):
+        out, aux = moe.switch_moe(tokens, router, w1, w2, mesh,
+                                  capacity_factor=float(E))
+        return jnp.sum(out ** 2) + 0.01 * aux
+
+    g1, g2 = jax.jit(jax.grad(loss, argnums=(0, 1)))(w1, w2, tokens)
+
+    def ref_loss(w1, w2, tokens):
+        out, aux = moe.switch_moe(tokens, router, w1, w2, None,
+                                  capacity_factor=float(E))
+        return jnp.sum(out ** 2) + 0.01 * aux
+
+    e1, e2 = jax.grad(ref_loss, argnums=(0, 1))(w1, w2, tokens)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(e1), rtol=1e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g2), np.asarray(e2), rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_capacity_bounds_dropped_tokens(tokens, weights):
+    """With tight capacity some tokens drop (zero output) but the op
+    stays finite and shaped."""
+    router, w1, w2 = weights
+    mesh = mesh_lib.build_mesh(num_partitions=4)
+    out, aux = moe.switch_moe(tokens, router, w1, w2, mesh,
+                              capacity_factor=0.5)
+    assert out.shape == (B, D)
+    assert np.isfinite(np.asarray(out)).all()
+    # at least one token dropped given the skewed router
+    dropped = np.asarray((jnp.sum(jnp.abs(out), axis=1) == 0))
+    assert dropped.any()
+
+
+def test_aux_loss_uniform_router_is_one():
+    """With a uniform router, E * sum f_e p_e == 1 (balanced)."""
+    tokens = jnp.ones((32, D))
+    router = jnp.zeros((D, E))
+    w1 = jnp.zeros((E, D, F))
+    w2 = jnp.zeros((E, F, D))
+    _, aux = moe.switch_moe(tokens, router, w1, w2, None)
+    np.testing.assert_allclose(float(aux), 1.0, rtol=1e-5)
