@@ -25,13 +25,22 @@ def main():
     ap.add_argument("--max_steps", type=int, default=50)
     ap.add_argument("--log_frequency", type=int, default=10)
     ap.add_argument("--partitions", type=int, default=None,
-                    help="sequence-parallel degree (shard axis size)")
+                    help="shard-axis size (sp or tp degree)")
+    ap.add_argument("--parallelism", default="ring",
+                    choices=["ring", "tensor", "data"],
+                    help="ring=sequence parallel, tensor=Megatron TP, "
+                         "data=pure dp")
+    ap.add_argument("--pallas_attention", action="store_true",
+                    help="fuse attention with the Pallas flash kernel "
+                         "(data/tensor modes)")
     args = ap.parse_args()
 
     cfg = lc.LongContextConfig(vocab_size=args.vocab_size,
                                model_dim=args.model_dim,
                                num_layers=args.num_layers,
-                               max_len=args.seq_len)
+                               max_len=args.seq_len,
+                               parallelism=args.parallelism,
+                               use_pallas_attention=args.pallas_attention)
     sess, _, worker_id, _ = parallax.parallel_run(
         lc.build_model(cfg), args.resource_info,
         parallax_config=parallax.Config(search_partitions=False),

@@ -340,9 +340,10 @@ class Engine:
             if name in overrides:
                 spec = overrides[name]
                 # in multiprocess mode the caller feeds a process-local
-                # slice, so each dim's requirement shrinks accordingly
+                # slice, so each dim's requirement shrinks by the process
+                # span of its axes
                 bad = spec_shape_mismatch(spec, x.shape, self.mesh,
-                                          jax.process_count())
+                                          local=multiprocess)
                 if bad is not None:
                     dim, axes, need = bad
                     raise ValueError(
@@ -382,17 +383,34 @@ class Engine:
             parallax_log.warning("graph export failed: %s", e)
 
 
-def spec_shape_mismatch(spec, shape, mesh, num_processes: int = 1):
+def _process_span(mesh: Mesh, axis: str) -> int:
+    """How many distinct processes the devices along ``axis`` belong to
+    (other axes fixed at index 0). 1 means the axis is intra-process."""
+    names = list(mesh.axis_names)
+    idx = [0] * len(names)
+    procs = set()
+    ax = names.index(axis)
+    for i in range(mesh.shape[axis]):
+        idx[ax] = i
+        procs.add(mesh.devices[tuple(idx)].process_index)
+    return max(1, len(procs))
+
+
+def spec_shape_mismatch(spec, shape, mesh, local: bool = False):
     """Check a PartitionSpec against an array shape: every constrained dim
-    must divide the product of its mesh axes (divided by ``num_processes``
-    when validating a process-local slice of a global array). Returns
-    (dim, axes, required) for the first violation, or None."""
+    must divide the product of its mesh axes. With ``local=True`` the
+    shape is a process-local slice, so each dim's requirement shrinks by
+    the number of processes its axes actually span (not by the global
+    process count — intra-process axes still demand the full split).
+    Returns (dim, axes, required) for the first violation, or None."""
     for dim, axes in enumerate(spec):
         if axes is None or dim >= len(shape):
             continue
         axes = (axes,) if isinstance(axes, str) else tuple(axes)
         size = int(np.prod([mesh.shape[a] for a in axes]))
-        size = max(1, size // num_processes)
+        if local:
+            span = int(np.prod([_process_span(mesh, a) for a in axes]))
+            size = max(1, size // span)
         if shape[dim] % size != 0:
             return dim, axes, size
     return None

@@ -39,7 +39,7 @@ def launch_workers(hosts: Sequence[HostInfo],
     serialized = serialize_resource_info(hosts)
     cmd = (_shell_quote(sys.executable) + " "
            + " ".join(_shell_quote(a) for a in sys.argv))
-    procs: List = []
+    procs: List = []          # (machine_id, Popen)
     # Reverse order, chief last (reference ps/runner.py:163-193: the chief
     # must come up after its peers are listening).
     for machine_id in reversed(range(len(hosts))):
@@ -63,21 +63,41 @@ def launch_workers(hosts: Sequence[HostInfo],
                                                  machine_id)
         parallax_log.info("launching worker %d on %s", machine_id,
                           host.hostname)
-        procs.append(remote_exec(cmd, host.hostname, env=env, stdout=stdout,
-                                 stderr=stderr))
-    chief = procs[-1]
+        procs.append((machine_id,
+                      remote_exec(cmd, host.hostname, env=env,
+                                  stdout=stdout, stderr=stderr)))
+    chief = procs[-1][1]
     try:
-        rc = chief.wait()
+        # Wait on the chief but abort the whole cluster as soon as ANY
+        # worker dies (the reference master only watched the chief,
+        # runner.py:124, leaving half-dead clusters hanging; the search
+        # loop then misread deaths, partitions.py:122-128).
+        import time as _time
+        while True:
+            rc = chief.poll()
+            if rc is not None:
+                break
+            for machine_id, p in procs:
+                if p is not chief and p.poll() not in (None, 0):
+                    parallax_log.error(
+                        "worker %d exited with %d; aborting cluster",
+                        machine_id, p.returncode)
+                    rc = p.returncode
+                    break
+            else:
+                _time.sleep(1.0)
+                continue
+            break
     except KeyboardInterrupt:
         rc = 130
     finally:
-        for p in procs:
+        for _, p in procs:
             if p.poll() is None:
                 try:
                     p.send_signal(signal.SIGINT)
                 except OSError:
                     pass
-        for p in procs:
+        for _, p in procs:
             try:
                 p.wait(timeout=30)
             except Exception:

@@ -36,13 +36,28 @@ class LongContextConfig:
     num_layers: int = 6
     max_len: int = 32768
     learning_rate: float = 3e-4
-    use_ring_attention: bool = True
+    # 'ring'  : sequence parallelism — seq dim over 'shard', ring attention
+    # 'tensor': tensor parallelism — Megatron-style column/row-parallel
+    #           kernels over 'shard' (GSPMD inserts the psum after the
+    #           row-parallel matmul), batch data-parallel over 'repl'
+    # 'data'  : pure data parallelism (attention unsharded)
+    parallelism: str = "ring"
+    # fuse attention with the Pallas flash kernel (data/tensor modes;
+    # ring mode has its own collective-fused path)
+    use_pallas_attention: bool = False
     compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def use_ring_attention(self) -> bool:
+        return self.parallelism == "ring"
 
 
 def tiny_config(**kw) -> LongContextConfig:
     defaults = dict(vocab_size=512, model_dim=32, num_heads=2, mlp_dim=64,
                     num_layers=2, max_len=64)
+    if "use_ring_attention" in kw:  # back-compat alias
+        kw["parallelism"] = ("ring" if kw.pop("use_ring_attention")
+                             else "data")
     defaults.update(kw)
     return LongContextConfig(**defaults)
 
@@ -90,6 +105,9 @@ def build_model(cfg: LongContextConfig) -> Model:
         if cfg.use_ring_attention and mesh is not None:
             out = ring_attention(q, k, v, mesh, AXIS_SHARD,
                                  causal=True, batch_axis=AXIS_REPL)
+        elif cfg.use_pallas_attention:
+            from parallax_tpu.ops.pallas_attention import flash_attention
+            out = flash_attention(q, k, v, causal=True)
         else:
             out = full_attention_reference(q, k, v, causal=True)
         return out.reshape(B, T, D) @ p["wo"].astype(dt)
@@ -119,14 +137,33 @@ def build_model(cfg: LongContextConfig) -> Model:
         loss = jnp.sum(nll * w) / jnp.sum(w)
         return loss, {"tokens": jnp.sum(w)}
 
-    # dp over 'repl', sp over 'shard': [batch, seq] inputs
-    batch_specs = {"ids": P(AXIS_REPL, AXIS_SHARD)}
-    return Model(init_fn, loss_fn,
-                 optimizer=optax.chain(optax.clip_by_global_norm(1.0),
-                                       optax.adam(cfg.learning_rate)),
-                 dense_params=("emb",),  # replicated: lookups follow the
-                                         # seq-sharded ids, not vocab rows
-                 batch_specs=batch_specs)
+    if cfg.parallelism not in ("ring", "tensor", "data"):
+        raise ValueError(
+            f"unknown parallelism {cfg.parallelism!r}; expected "
+            f"'ring', 'tensor' or 'data'")
+    tx = optax.chain(optax.clip_by_global_norm(1.0),
+                     optax.adam(cfg.learning_rate))
+    if cfg.parallelism == "tensor":
+        # Megatron-style TP: qkv/up-proj column-parallel, out/down-proj
+        # row-parallel over 'shard'; batch data-parallel over 'repl'.
+        # GSPMD partitions the matmuls and inserts the all-reduce after
+        # each row-parallel kernel.
+        return Model(
+            init_fn, loss_fn, optimizer=tx, dense_params=("emb",),
+            batch_specs={"ids": P(AXIS_REPL, None)},
+            param_specs={
+                "blocks/*/wqkv": P(None, AXIS_SHARD),
+                "blocks/*/w1": P(None, AXIS_SHARD),
+                "blocks/*/wo": P(AXIS_SHARD, None),
+                "blocks/*/w2": P(AXIS_SHARD, None),
+            })
+    if cfg.parallelism == "ring":
+        # dp over 'repl', sp over 'shard': [batch, seq] inputs
+        return Model(init_fn, loss_fn, optimizer=tx,
+                     dense_params=("emb",),  # replicated: lookups follow
+                                             # seq-sharded ids, not rows
+                     batch_specs={"ids": P(AXIS_REPL, AXIS_SHARD)})
+    return Model(init_fn, loss_fn, optimizer=tx)
 
 
 def make_batch(rng: np.random.Generator, batch_size: int, seq_len: int,

@@ -1,0 +1,156 @@
+"""Pallas flash-attention forward kernel (TPU).
+
+The hot op of every transformer family here (NMT, BERT, long-context,
+MoE-LM) is attention; this is its Pallas implementation: one fused kernel
+per (batch, head, q-tile) program that streams K/V tiles through VMEM
+with the online-softmax recurrence — the [Tq, Tk] score matrix never
+exists in HBM.
+
+Gradients: the forward runs the Pallas kernel under a `custom_vjp`; the
+backward recomputes attention with the plain XLA einsum formulation
+(standard recompute-in-backward trade — matches the forward numerics to
+float32 accumulation). A fully-Pallas backward is a later optimization.
+
+On non-TPU backends the same kernel runs in interpret mode (tests), so
+numerics are validated everywhere the framework runs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_len: int,
+                      block_k: int, causal: bool, scale: float,
+                      q_tile: int):
+    # q_ref: [q_tile, D]; k_ref/v_ref: [Tk, D]; o_ref: [q_tile, D]
+    qt = pl.program_id(2)
+    q = q_ref[0, 0] * scale                                # [q_tile, D]
+    D = q.shape[-1]
+
+    m = jnp.full((q_tile,), _NEG_INF, jnp.float32)
+    l = jnp.zeros((q_tile,), jnp.float32)
+    acc = jnp.zeros((q_tile, D), jnp.float32)
+
+    num_k = kv_len // block_k
+
+    def body(kt, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.dslice(kt * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.dslice(kt * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [q_tile, bk]
+        if causal:
+            q_pos = qt * q_tile + jax.lax.broadcasted_iota(
+                jnp.int32, (q_tile, block_k), 0)
+            k_pos = kt * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (q_tile, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m, l, acc))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float,
+                   q_tile: int, block_k: int, interpret: bool):
+    """q, k, v: [B, H, T, D] -> [B, H, T, D]."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    q_tile = min(q_tile, Tq)
+    block_k = min(block_k, Tk)
+    while Tq % q_tile:
+        q_tile //= 2
+    while Tk % block_k:
+        block_k //= 2
+    grid = (B, H, Tq // q_tile)
+    kernel = functools.partial(
+        _flash_fwd_kernel, kv_len=Tk, block_k=block_k, causal=causal,
+        scale=scale, q_tile=q_tile)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, q_tile, D),
+                         lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, q_tile, D),
+                               lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _xla_attention(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k,
+                   preferred_element_type=jnp.float32)
+    if causal:
+        T, Tk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((T, Tk), bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, scale, q_tile, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, q_tile, block_k,
+                          interpret)
+
+
+def _fwd(q, k, v, causal, scale, q_tile, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, scale, q_tile, block_k,
+                         interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, scale, q_tile, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal,
+                                                    scale), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_fwd, _bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False,
+                    scale: Optional[float] = None,
+                    q_tile: int = 256, block_k: int = 256,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Fused attention: q, k, v [B, T, H, D] -> [B, T, H, D].
+
+    ``interpret`` defaults to True off-TPU (so CPU tests exercise the
+    same kernel) and False on TPU.
+    """
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash_attention(qt, kt, vt, causal, float(scale), q_tile,
+                           block_k, interpret)
+    return out.transpose(0, 2, 1, 3)

@@ -156,6 +156,15 @@ class ParallaxSession:
             return
         mean_t = float(np.mean(self._step_times[warm:test]))
         self._step_times = []
+        import jax
+        if jax.process_count() > 1:
+            # All processes must take identical re-plan decisions (they
+            # jit the same mesh), so agree on one timing: the average
+            # across hosts, the reference's get_average_execution_time
+            # (lib.py:211-256) without the socket protocol.
+            from jax.experimental import multihost_utils
+            mean_t = float(multihost_utils.process_allgather(
+                np.asarray([mean_t])).mean())
         nxt = self._search.report(mesh_lib.num_shards(self._engine.mesh),
                                   mean_t)
         if nxt is None:

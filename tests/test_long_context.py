@@ -12,7 +12,7 @@ from parallax_tpu.models import long_context as lc
 def test_seq_parallel_training_matches_full_attention(rng):
     """Same model, ring attention over the sp axis vs full attention on a
     single logical device: identical loss trajectories."""
-    batches = [lc.make_batch(rng, 4, 32, 512) for _ in range(4)]
+    batches = [lc.make_batch(rng, 8, 32, 512) for _ in range(4)]
 
     def run(use_ring, num_partitions):
         cfg = lc.tiny_config(use_ring_attention=use_ring)
@@ -40,9 +40,9 @@ def test_activations_are_sequence_sharded(rng):
         parallax_config=parallax.Config(run_option="HYBRID",
                                         search_partitions=False),
         num_partitions=4)
-    batch = lc.make_batch(rng, 4, 32, 512)
+    batch = lc.make_batch(rng, 8, 32, 512)
     out = sess.run(None, feed_dict=batch)
-    assert out["tokens"] == 4 * 31
+    assert out["tokens"] == 8 * 31
     # input layout: [batch over repl, seq over shard]
     placed = sess.engine.shard_batch(batch)
     spec = placed["ids"].sharding.spec

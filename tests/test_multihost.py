@@ -15,10 +15,14 @@ import pytest
 
 
 def test_two_process_launch_and_training(tmp_path):
+    import socket
+    with socket.socket() as s:  # grab a free port; avoids collisions
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
     out = str(tmp_path / "result")
     env = dict(os.environ)
     env.update({
-        "PARALLAX_COORDINATOR_PORT": "8931",
+        "PARALLAX_COORDINATOR_PORT": str(port),
         "PALLAS_AXON_POOL_IPS": "",
         "PYTHONPATH": os.getcwd() + os.pathsep + env.get("PYTHONPATH", ""),
     })
