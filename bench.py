@@ -21,7 +21,8 @@ import jax
 import numpy as np
 
 
-def _run(model, cfg, batch_size, num_steps, steps, warmup, run_option):
+def _run(model, cfg, batch_size, num_steps, steps, warmup, run_option,
+         wire_stats=None):
     import parallax_tpu as parallax
     from parallax_tpu.models import lm1b
 
@@ -33,6 +34,9 @@ def _run(model, cfg, batch_size, num_steps, steps, warmup, run_option):
                for _ in range(4)]
     for i in range(warmup):
         sess.run("loss", feed_dict=batches[i % 4])
+    if wire_stats is not None:
+        wire_stats.update(
+            sess.engine.sparse_wire_bytes_per_step(batches[0]))
     jax.block_until_ready(sess.state.params)
     t0 = time.perf_counter()
     words = 0
@@ -62,8 +66,9 @@ def main():
         small_bs = 16 * n_chips
 
     # Headline: hybrid engine at the realistic batch size.
+    wire = {}
     hybrid_wps = _run(lm1b.build_model(cfg), cfg, bs, T, steps, warmup,
-                      "HYBRID")
+                      "HYBRID", wire_stats=wire)
     # Baseline comparison at a common batch size both paths can run.
     sampled_small = _run(lm1b.build_model(cfg), cfg, small_bs, T,
                          max(5, steps // 3), warmup, "HYBRID")
@@ -71,12 +76,19 @@ def main():
                       max(5, steps // 3), warmup, "HYBRID")
 
     per_chip = hybrid_wps / n_chips
-    print(json.dumps({
+    result = {
         "metric": "lm1b_words_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "words/sec/chip",
         "vs_baseline": round(sampled_small / full_small, 3),
-    }))
+    }
+    if wire.get("dense_allreduce_bytes"):
+        # north-star secondary metric: sparse-grad bytes on wire per step
+        # vs shipping dense [V, D] gradients
+        result["sparse_grad_bytes_on_wire"] = wire["sparse_path_bytes"]
+        result["dense_grad_bytes_equivalent"] = \
+            wire["dense_allreduce_bytes"]
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

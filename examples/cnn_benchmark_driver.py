@@ -27,6 +27,8 @@ def main():
     ap.add_argument("--max_steps", type=int, default=100)
     ap.add_argument("--log_frequency", type=int, default=10)
     ap.add_argument("--run_option", default="HYBRID")
+    ap.add_argument("--ckpt_dir", default=None)
+    ap.add_argument("--save_ckpt_steps", type=int, default=None)
     args = ap.parse_args()
 
     size = args.image_size or cnn.default_image_size(args.model)
@@ -34,8 +36,11 @@ def main():
                             image_size=size)
     sess, num_workers, worker_id, num_replicas = parallax.parallel_run(
         model, args.resource_info,
-        parallax_config=parallax.Config(run_option=args.run_option,
-                                        search_partitions=False))
+        parallax_config=parallax.Config(
+            run_option=args.run_option, search_partitions=False,
+            ckpt_config=parallax.CheckPointConfig(
+                ckpt_dir=args.ckpt_dir,
+                save_ckpt_steps=args.save_ckpt_steps)))
     print(f"model={args.model} image={size} workers={num_workers} "
           f"replicas={num_replicas}")
 

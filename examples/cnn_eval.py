@@ -1,0 +1,69 @@
+"""CNN evaluation: restore a checkpoint, report top-1 accuracy.
+
+Parity with the reference's eval flow (reference:
+examples/tf_cnn_benchmarks/CNNBenchmark_eval.py — separate script
+restoring the training checkpoint and running inference-mode evaluation,
+i.e. BatchNorm uses the running statistics).
+"""
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import parallax_tpu as parallax
+from parallax_tpu.models import cnn
+
+
+from parallax_tpu.checkpoint import restore_train_state
+
+
+def evaluate(module_name: str, num_classes: int, state,
+             batches) -> float:
+    """Top-1 accuracy in inference mode (running BatchNorm stats)."""
+    factory, _ = cnn.MODEL_REGISTRY[module_name]
+    module = factory(num_classes=num_classes)
+
+    @jax.jit
+    def predict(params, model_state, images):
+        variables = {"params": params, **(model_state or {})}
+        return module.apply(variables, images, train=False)
+
+    correct = total = 0
+    for batch in batches:
+        logits = predict(state.params, state.model_state,
+                         jnp.asarray(batch["images"]))
+        correct += int((jnp.argmax(logits, -1)
+                        == jnp.asarray(batch["labels"])).sum())
+        total += batch["labels"].shape[0]
+    return correct / max(total, 1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt_dir", required=True)
+    ap.add_argument("--model", default="resnet50_v1.5",
+                    choices=sorted(cnn.MODEL_REGISTRY))
+    ap.add_argument("--num_classes", type=int, default=1000)
+    ap.add_argument("--image_size", type=int, default=None)
+    ap.add_argument("--batch_size", type=int, default=64)
+    ap.add_argument("--eval_batches", type=int, default=10)
+    args = ap.parse_args()
+
+    size = args.image_size or cnn.default_image_size(args.model)
+    model = cnn.build_model(args.model, num_classes=args.num_classes,
+                            image_size=size)
+    state, step = restore_train_state(args.ckpt_dir, model)
+    print(f"restored step {step}")
+    rng = np.random.default_rng(123)
+    batches = [cnn.make_batch(rng, args.batch_size, size,
+                              args.num_classes)
+               for _ in range(args.eval_batches)]
+    acc = evaluate(args.model, args.num_classes, state, batches)
+    print(f"top-1 accuracy: {acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -16,24 +16,13 @@ from parallax_tpu.models import lm1b
 from parallax_tpu.ops import sampled_softmax as ss_ops
 
 
+from parallax_tpu.checkpoint import restore_train_state
+
+
 def restore_params(ckpt_dir: str, cfg: lm1b.LM1BConfig):
     """Restore the latest training checkpoint's params pytree."""
-    import orbax.checkpoint as ocp
-    import os
-    mngr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
-    latest = mngr.latest_step()
-    if latest is None:
-        raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
-    model = lm1b.build_model(cfg)
-    params, _ = model.call_init(jax.random.PRNGKey(0))
-    opt_state = model.optimizer.init(params)
-    template = parallax.TrainState(
-        step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state,
-        rng=jax.random.PRNGKey(0), model_state=None)
-    abstract = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template)
-    restored = mngr.restore(latest, args=ocp.args.StandardRestore(abstract))
-    mngr.close()
+    restored, latest = restore_train_state(ckpt_dir,
+                                           lm1b.build_model(cfg))
     return restored.params, latest
 
 

@@ -91,3 +91,32 @@ class CheckpointHook:
         if self._mngr is not None:
             self._mngr.wait_until_finished()
             self._mngr.close()
+
+
+def restore_train_state(ckpt_dir: str, model, seed: int = 0):
+    """Restore the latest checkpoint into a fresh TrainState template for
+    ``model`` (eval flows: lm1b_eval, cnn_eval). Returns (state, step)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+
+    from parallax_tpu.core.engine import TrainState
+
+    mngr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
+    latest = mngr.latest_step()
+    if latest is None:
+        mngr.close()
+        raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+    params, mstate = model.call_init(jax.random.PRNGKey(seed))
+    template = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=model.optimizer.init(params),
+        rng=jax.random.PRNGKey(seed), model_state=mstate)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template)
+    restored = mngr.restore(latest,
+                            args=ocp.args.StandardRestore(abstract))
+    mngr.close()
+    return restored, latest

@@ -264,6 +264,8 @@ class Engine:
         param_shardings = self._param_shardings
         avg = config.average_sparse
         sharded_shapes = self.plan.sharded_shapes
+        self._lookup_records: Dict = {}
+        lookup_records = self._lookup_records
 
         def init_state(seed: jax.Array) -> TrainState:
             rng = jax.random.PRNGKey(seed)
@@ -280,8 +282,9 @@ class Engine:
             step_rng = jax.random.fold_in(state.rng, state.step)
 
             def loss_wrap(params):
-                with embedding.sharded_lookup_scope(mesh, sharded_shapes,
-                                                    avg):
+                with embedding.sharded_lookup_scope(
+                        mesh, sharded_shapes, avg,
+                        records=lookup_records):
                     loss, metrics, new_mstate = model.call_loss(
                         params, batch, step_rng, state.model_state)
                 return loss, (metrics, new_mstate)
@@ -364,6 +367,29 @@ class Engine:
             return {k: jax.tree.map(lambda x, k=k: put(k, x), v)
                     for k, v in batch.items()}
         return jax.tree.map(lambda x: put("", x), batch)
+
+    def sparse_wire_bytes_per_step(self, batch=None) -> Dict[str, int]:
+        """Exact bytes-on-wire per step for the sparse path vs the dense
+        alternative (the BASELINE.json north-star metric), computed from
+        the trace-time record of every sharded lookup.
+
+        Sparse path per lookup (ops/embedding.py): forward
+        all_gather(ids, int32) + psum_scatter(rows), backward
+        all_gather(row grads) — O(ids · dim). Dense alternative: ring
+        all-reduce of each full [V, D] gradient (~2 bytes moved per
+        gradient byte). Call after the first step has compiled.
+        """
+        sparse_bytes = 0
+        dense_bytes = 0
+        dense_tables = set()
+        for (tshape, _), n_ids in self._lookup_records.items():
+            dim = int(np.prod(tshape[1:])) if len(tshape) > 1 else 1
+            sparse_bytes += n_ids * 4 + 2 * n_ids * dim * 4
+            dense_tables.add(tshape)
+        for tshape in dense_tables:
+            dense_bytes += 2 * int(np.prod(tshape)) * 4
+        return {"sparse_path_bytes": sparse_bytes,
+                "dense_allreduce_bytes": dense_bytes}
 
     def _export_graph(self, state, batch):
         """Dump compiled-step HLO text (reference: export_graph_path dumps

@@ -1,0 +1,36 @@
+"""export_graph_path + misc engine behaviors."""
+
+import glob
+import os
+
+import numpy as np
+
+import parallax_tpu as parallax
+from parallax_tpu.models import simple
+
+
+def test_export_graph_path_writes_stablehlo(tmp_path, rng):
+    export_dir = str(tmp_path / "graph")
+    cfg = parallax.Config(run_option="AR", search_partitions=False,
+                          export_graph_path=export_dir)
+    sess, *_ = parallax.parallel_run(simple.build_model(),
+                                     parallax_config=cfg)
+    b = simple.make_batch(rng, 64)
+    sess.run(None, feed_dict=b)
+    sess.run(None, feed_dict=b)
+    files = glob.glob(os.path.join(export_dir, "*"))
+    assert files, "no graph exported"
+    text = open(files[0]).read()
+    assert "stablehlo" in text or "module" in text
+    sess.close()
+
+
+def test_unused_knobs_logged_not_fatal(rng):
+    cfg = parallax.Config(run_option="AR", search_partitions=False)
+    cfg.communication_config.ps_config.protocol = "grpc+verbs"
+    cfg.communication_config.mpi_config.mpirun_options = "-x FOO"
+    sess, *_ = parallax.parallel_run(simple.build_model(),
+                                     parallax_config=cfg)
+    loss = sess.run("loss", feed_dict=simple.make_batch(rng, 64))
+    assert np.isfinite(loss)
+    sess.close()
