@@ -36,7 +36,7 @@ def _run(model, cfg, batch_size, num_steps, steps, warmup, run_option,
         sess.run("loss", feed_dict=batches[i % 4])
     if wire_stats is not None:
         wire_stats.update(
-            sess.engine.sparse_wire_bytes_per_step(batches[0]))
+            sess.engine.sparse_wire_bytes_per_step())
     jax.block_until_ready(sess.state.params)
     t0 = time.perf_counter()
     words = 0

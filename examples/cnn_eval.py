@@ -7,13 +7,11 @@ i.e. BatchNorm uses the running statistics).
 """
 
 import argparse
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-import parallax_tpu as parallax
 from parallax_tpu.models import cnn
 
 

@@ -264,7 +264,7 @@ class Engine:
         param_shardings = self._param_shardings
         avg = config.average_sparse
         sharded_shapes = self.plan.sharded_shapes
-        self._lookup_records: Dict = {}
+        self._lookup_records: list = []
         lookup_records = self._lookup_records
 
         def init_state(seed: jax.Array) -> TrainState:
@@ -282,6 +282,9 @@ class Engine:
             step_rng = jax.random.fold_in(state.rng, state.step)
 
             def loss_wrap(params):
+                # one trace = one step's lookups; retraces (new batch
+                # shape) replace rather than accumulate
+                lookup_records.clear()
                 with embedding.sharded_lookup_scope(
                         mesh, sharded_shapes, avg,
                         records=lookup_records):
@@ -368,26 +371,27 @@ class Engine:
                     for k, v in batch.items()}
         return jax.tree.map(lambda x: put("", x), batch)
 
-    def sparse_wire_bytes_per_step(self, batch=None) -> Dict[str, int]:
+    def sparse_wire_bytes_per_step(self) -> Dict[str, int]:
         """Exact bytes-on-wire per step for the sparse path vs the dense
-        alternative (the BASELINE.json north-star metric), computed from
-        the trace-time record of every sharded lookup.
+        alternative (the BASELINE.json north-star metric).
 
-        Sparse path per lookup (ops/embedding.py): forward
-        all_gather(ids, int32) + psum_scatter(rows), backward
-        all_gather(row grads) — O(ids · dim). Dense alternative: ring
-        all-reduce of each full [V, D] gradient (~2 bytes moved per
-        gradient byte). Call after the first step has compiled.
+        Sparse path: one record per sharded lookup event in the latest
+        trace (ops/embedding.py) — forward all_gather(ids, int32) +
+        psum_scatter(rows), backward all_gather(row grads), O(ids · dim)
+        each. Dense alternative: ring all-reduce of every row-sharded
+        variable's full gradient (~2 bytes moved per gradient byte),
+        counted per *variable* from the plan so same-shaped tables don't
+        collapse. Call after the first step has compiled.
         """
         sparse_bytes = 0
-        dense_bytes = 0
-        dense_tables = set()
-        for (tshape, _), n_ids in self._lookup_records.items():
+        for tshape, n_ids in self._lookup_records:
             dim = int(np.prod(tshape[1:])) if len(tshape) > 1 else 1
             sparse_bytes += n_ids * 4 + 2 * n_ids * dim * 4
-            dense_tables.add(tshape)
-        for tshape in dense_tables:
-            dense_bytes += 2 * int(np.prod(tshape)) * 4
+        dense_bytes = 0
+        for vs in self.plan.var_specs.values():
+            if vs.is_sparse and tuple(vs.shape) in \
+                    self.plan.sharded_shapes:
+                dense_bytes += 2 * int(np.prod(vs.shape)) * 4
         return {"sparse_path_bytes": sparse_bytes,
                 "dense_allreduce_bytes": dense_bytes}
 

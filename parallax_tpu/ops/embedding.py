@@ -48,9 +48,10 @@ class _MeshCtx:
     mesh: Mesh
     sharded_shapes: frozenset  # shapes (tuples) of row-sharded tables
     average_duplicates: bool
-    # trace-time record of sharded lookups, (table_shape, ids_shape) ->
-    # flattened id count — feeds the exact bytes-on-wire accounting
-    records: Optional[dict] = None
+    # trace-time record of sharded lookups: list of (table_shape,
+    # flattened id count), one entry per lookup event in the trace —
+    # feeds the exact bytes-on-wire accounting
+    records: Optional[list] = None
 
 
 _CTX: contextvars.ContextVar[Optional[_MeshCtx]] = contextvars.ContextVar(
@@ -60,7 +61,7 @@ _CTX: contextvars.ContextVar[Optional[_MeshCtx]] = contextvars.ContextVar(
 @contextlib.contextmanager
 def sharded_lookup_scope(mesh: Mesh, sharded_shapes,
                          average_duplicates: bool = False,
-                         records: Optional[dict] = None):
+                         records: Optional[list] = None):
     """Engine-installed scope: inside it, ``embedding_lookup`` of a table
     whose shape is registered routes through the sharded collective path."""
     token = _CTX.set(_MeshCtx(mesh, frozenset(tuple(s) for s in
@@ -122,8 +123,7 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
     if not use_sharded or ctx is None or ctx.mesh.shape[AXIS_SHARD] == 1:
         return jnp.take(table, ids, axis=0)
     if ctx.records is not None:
-        key = (tuple(table.shape), tuple(ids.shape))
-        ctx.records[key] = int(np.prod(ids.shape))
+        ctx.records.append((tuple(table.shape), int(np.prod(ids.shape))))
     if ctx.average_duplicates:
         return _sharded_lookup_avg(table, ids, ctx.mesh)
     return _sharded_lookup(table, ids, ctx.mesh)

@@ -6,12 +6,14 @@ per (batch, head, q-tile) program that streams K/V tiles through VMEM
 with the online-softmax recurrence — the [Tq, Tk] score matrix never
 exists in HBM.
 
-Gradients: the forward runs the Pallas kernel under a `custom_vjp`; the
-backward recomputes attention with the plain XLA einsum formulation
-(standard recompute-in-backward trade — matches the forward numerics to
-float32 accumulation). A fully-Pallas backward is a later optimization.
+Gradients: fully-Pallas backward — the forward kernel additionally emits
+the per-row logsumexp; the backward recomputes P tiles from (q, k, lse)
+and accumulates dq (one kernel, grid over q-tiles) and dk/dv (one
+kernel, grid over k-tiles) flash-attention style, so the backward never
+materializes [Tq, Tk] either. Set ``xla_backward=True`` to fall back to
+the einsum-recompute backward.
 
-On non-TPU backends the same kernel runs in interpret mode (tests), so
+On non-TPU backends the same kernels run in interpret mode (tests), so
 numerics are validated everywhere the framework runs.
 """
 
@@ -28,9 +30,9 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_len: int,
-                      block_k: int, causal: bool, scale: float,
-                      q_tile: int):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      kv_len: int, block_k: int, causal: bool,
+                      scale: float, q_tile: int):
     # q_ref: [q_tile, D]; k_ref/v_ref: [Tk, D]; o_ref: [q_tile, D]
     qt = pl.program_id(2)
     q = q_ref[0, 0] * scale                                # [q_tile, D]
@@ -67,19 +69,23 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, kv_len: int,
 
     m, l, acc = jax.lax.fori_loop(0, num_k, body, (m, l, acc))
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _snap(tile, total):
+    tile = min(tile, total)
+    while total % tile:
+        tile //= 2
+    return max(tile, 1)
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float,
                    q_tile: int, block_k: int, interpret: bool):
-    """q, k, v: [B, H, T, D] -> [B, H, T, D]."""
+    """q, k, v: [B, H, T, D] -> (out [B, H, T, D], lse [B, H, T])."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    q_tile = min(q_tile, Tq)
-    block_k = min(block_k, Tk)
-    while Tq % q_tile:
-        q_tile //= 2
-    while Tk % block_k:
-        block_k //= 2
+    q_tile = _snap(q_tile, Tq)
+    block_k = _snap(block_k, Tk)
     grid = (B, H, Tq // q_tile)
     kernel = functools.partial(
         _flash_fwd_kernel, kv_len=Tk, block_k=block_k, causal=causal,
@@ -93,11 +99,156 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
             pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, 1, q_tile, D),
+                         lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, q_tile), lambda b, h, i: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, *, kv_len: int, block_k: int, causal: bool,
+                     scale: float, q_tile: int):
+    qt = pl.program_id(2)
+    q = q_ref[0, 0] * scale                                # [qt, D]
+    do = do_ref[0, 0].astype(jnp.float32)                  # [qt, D]
+    lse = lse_ref[0, 0]                                    # [qt]
+    delta = delta_ref[0, 0]                                # [qt]
+    D = q.shape[-1]
+    dq = jnp.zeros((q_tile, D), jnp.float32)
+    num_k = kv_len // block_k
+
+    def body(kt, dq):
+        k_blk = k_ref[0, 0, pl.dslice(kt * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.dslice(kt * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [qt, bk]
+        if causal:
+            q_pos = qt * q_tile + jax.lax.broadcasted_iota(
+                jnp.int32, (q_tile, block_k), 0)
+            k_pos = kt * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (q_tile, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [qt, bk]
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k_blk.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    dq = jax.lax.fori_loop(0, num_k, body, dq)
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, *, q_len: int, q_blk: int,
+                      causal: bool, scale: float, k_tile: int):
+    kt = pl.program_id(2)
+    k = k_ref[0, 0]                                        # [kt_, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    D = k.shape[-1]
+    dk = jnp.zeros((k_tile, D), jnp.float32)
+    dv = jnp.zeros((k_tile, D), jnp.float32)
+    num_q = q_len // q_blk
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.dslice(qi * q_blk, q_blk), :] * scale
+        do = do_ref[0, 0, pl.dslice(qi * q_blk, q_blk), :].astype(
+            jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(qi * q_blk, q_blk)]
+        delta = delta_ref[0, 0, pl.dslice(qi * q_blk, q_blk)]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [qb, kt_]
+        if causal:
+            q_pos = qi * q_blk + jax.lax.broadcasted_iota(
+                jnp.int32, (q_blk, k_tile), 0)
+            k_pos = kt * k_tile + jax.lax.broadcasted_iota(
+                jnp.int32, (q_blk, k_tile), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [kt_, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [qb, kt_]
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+    dk, dv = jax.lax.fori_loop(0, num_q, body, (dk, dv))
+    # q was pre-scaled, so dk absorbed one factor of `scale` already
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, q_tile,
+                    block_k, interpret):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    q_tile = _snap(q_tile, Tq)
+    block_k = _snap(block_k, Tk)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                               # [B, H, Tq]
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, kv_len=Tk, block_k=block_k,
+                          causal=causal, scale=scale, q_tile=q_tile),
+        grid=(B, H, Tq // q_tile),
+        in_specs=[
+            pl.BlockSpec((1, 1, q_tile, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, q_tile, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, q_tile), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, q_tile), lambda b, h, i: (b, h, i)),
+        ],
         out_specs=pl.BlockSpec((1, 1, q_tile, D),
                                lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, q_len=Tq, q_blk=q_tile,
+                          causal=causal, scale=scale, k_tile=block_k),
+        grid=(B, H, Tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, Tq, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, Tq, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tq), lambda b, h, j: (b, h, 0)),
+            pl.BlockSpec((1, 1, Tq), lambda b, h, j: (b, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, j: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Tk, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 def _xla_attention(q, k, v, causal, scale):
@@ -112,23 +263,30 @@ def _xla_attention(q, k, v, causal, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention(q, k, v, causal, scale, q_tile, block_k, interpret):
-    return _flash_forward(q, k, v, causal, scale, q_tile, block_k,
-                          interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention(q, k, v, causal, scale, q_tile, block_k, interpret,
+                     xla_backward):
+    out, _ = _flash_forward(q, k, v, causal, scale, q_tile, block_k,
+                            interpret)
+    return out
 
 
-def _fwd(q, k, v, causal, scale, q_tile, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, q_tile, block_k,
-                         interpret)
-    return out, (q, k, v)
+def _fwd(q, k, v, causal, scale, q_tile, block_k, interpret,
+         xla_backward):
+    out, lse = _flash_forward(q, k, v, causal, scale, q_tile, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, scale, q_tile, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal,
-                                                    scale), q, k, v)
-    return vjp(g)
+def _bwd(causal, scale, q_tile, block_k, interpret, xla_backward, res,
+         g):
+    q, k, v, out, lse = res
+    if xla_backward:
+        _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal,
+                                                        scale), q, k, v)
+        return vjp(g)
+    return _flash_backward(q, k, v, out, lse, g, causal, scale, q_tile,
+                           block_k, interpret)
 
 
 _flash_attention.defvjp(_fwd, _bwd)
@@ -138,11 +296,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False,
                     scale: Optional[float] = None,
                     q_tile: int = 256, block_k: int = 256,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    xla_backward: bool = False) -> jax.Array:
     """Fused attention: q, k, v [B, T, H, D] -> [B, T, H, D].
 
     ``interpret`` defaults to True off-TPU (so CPU tests exercise the
-    same kernel) and False on TPU.
+    same kernels) and False on TPU. ``xla_backward=True`` swaps the
+    Pallas backward kernels for the einsum-recompute fallback.
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
@@ -152,5 +312,5 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     out = _flash_attention(qt, kt, vt, causal, float(scale), q_tile,
-                           block_k, interpret)
+                           block_k, interpret, xla_backward)
     return out.transpose(0, 2, 1, 3)
