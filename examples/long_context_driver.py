@@ -33,6 +33,8 @@ def main():
     ap.add_argument("--pallas_attention", action="store_true",
                     help="fuse attention with the Pallas flash kernel "
                          "(data/tensor modes)")
+    ap.add_argument("--zigzag", action="store_true",
+                    help="balanced causal placement for ring mode")
     args = ap.parse_args()
 
     cfg = lc.LongContextConfig(vocab_size=args.vocab_size,
@@ -40,6 +42,7 @@ def main():
                                num_layers=args.num_layers,
                                max_len=args.seq_len,
                                parallelism=args.parallelism,
+                               zigzag=args.zigzag,
                                use_pallas_attention=args.pallas_attention)
     sess, _, worker_id, _ = parallax.parallel_run(
         lc.build_model(cfg), args.resource_info,

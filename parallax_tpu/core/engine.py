@@ -87,6 +87,10 @@ class Model:
         # layouts the dense/sparse classifier can't infer (e.g. expert
         # weights sharded P('shard', None, None), tensor-parallel kernels)
         self.param_specs = dict(param_specs or {})
+        # feed name -> fn(np_array, mesh) applied host-side before
+        # placement (e.g. zig-zag sequence permutation for balanced
+        # causal ring attention)
+        self.feed_transforms: Dict[str, Callable] = {}
         try:
             n_pos = len([
                 p for p in inspect.signature(loss_fn).parameters.values()
@@ -341,8 +345,12 @@ class Engine:
                 return jax.make_array_from_process_local_data(sharding, x)
             return jax.device_put(x, sharding)
 
+        transforms = self.model.feed_transforms
+
         def put(name, x):
             x = np.asarray(x)
+            if name in transforms:
+                x = np.asarray(transforms[name](x, self.mesh))
             if name in overrides:
                 spec = overrides[name]
                 # in multiprocess mode the caller feeds a process-local

@@ -24,7 +24,9 @@ from parallax_tpu.core.engine import Model
 from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD
 from parallax_tpu.ops import embedding as emb_ops
 from parallax_tpu.ops.ring_attention import (full_attention_reference,
-                                             ring_attention)
+                                             inverse_zigzag_permutation,
+                                             ring_attention,
+                                             zigzag_permutation)
 
 
 @dataclasses.dataclass
@@ -42,6 +44,10 @@ class LongContextConfig:
     #           row-parallel matmul), batch data-parallel over 'repl'
     # 'data'  : pure data parallelism (attention unsharded)
     parallelism: str = "ring"
+    # zig-zag sequence placement in ring mode: balances the causal
+    # workload across the ring (each device holds a low block and its
+    # mirrored high block); the engine permutes the fed ids host-side
+    zigzag: bool = False
     # fuse attention with the Pallas flash kernel (data/tensor modes;
     # ring mode has its own collective-fused path)
     use_pallas_attention: bool = False
@@ -65,6 +71,14 @@ def tiny_config(**kw) -> LongContextConfig:
 def build_model(cfg: LongContextConfig) -> Model:
     V, D, Hn = cfg.vocab_size, cfg.model_dim, cfg.num_heads
     dt = cfg.compute_dtype
+
+    if cfg.zigzag and cfg.parallelism != "ring":
+        raise ValueError(
+            "zigzag placement only applies to parallelism='ring'")
+
+    def _zigzag_active(mesh) -> bool:
+        return (cfg.zigzag and cfg.parallelism == "ring"
+                and mesh is not None and mesh.shape[AXIS_SHARD] > 1)
 
     def dense_init(rng, shape):
         return jax.random.normal(rng, shape) * (1.0 / np.sqrt(shape[0]))
@@ -103,8 +117,11 @@ def build_model(cfg: LongContextConfig) -> Model:
         v = v.reshape(B, T, Hn, D // Hn)
         mesh = emb_ops.current_mesh()
         if cfg.use_ring_attention and mesh is not None:
+            placement = ("zigzag" if _zigzag_active(mesh)
+                         else "contiguous")
             out = ring_attention(q, k, v, mesh, AXIS_SHARD,
-                                 causal=True, batch_axis=AXIS_REPL)
+                                 causal=True, batch_axis=AXIS_REPL,
+                                 placement=placement)
         elif cfg.use_pallas_attention:
             from parallax_tpu.ops.pallas_attention import flash_attention
             out = flash_attention(q, k, v, causal=True)
@@ -118,8 +135,23 @@ def build_model(cfg: LongContextConfig) -> Model:
         if T > cfg.max_len:
             raise ValueError(
                 f"sequence length {T} exceeds max_len {cfg.max_len}")
+        mesh = emb_ops.current_mesh()
+        zig = _zigzag_active(mesh)
+        if zig:
+            # ids arrive zig-zag permuted (engine feed transform): slot j
+            # holds real position perm[j]; positions and next-token
+            # labels follow the static permutation arrays.
+            n = mesh.shape[AXIS_SHARD]
+            perm = zigzag_permutation(T, n)
+            inv = inverse_zigzag_permutation(T, n)
+            pos_rows = perm
+            label_map = inv[(perm + 1) % T]
+            w_np = (perm != T - 1).astype(np.float32)
+        else:
+            pos_rows = np.arange(T)
+
         x = emb_ops.embedding_lookup(params["emb"], ids).astype(dt)
-        x = x + params["pos"][:T].astype(dt)[None]
+        x = x + params["pos"][pos_rows].astype(dt)[None]
         for p in params["blocks"]:
             ln = p["ln1"]
             x = x + attention(
@@ -128,12 +160,18 @@ def build_model(cfg: LongContextConfig) -> Model:
             h = layer_norm(x, ln["s"].astype(dt), ln["b"].astype(dt))
             x = x + jax.nn.relu(h @ p["w1"].astype(dt)) @ p["w2"].astype(dt)
         logits = x.astype(jnp.float32) @ params["out_w"]
-        labels = jnp.concatenate(
-            [ids[:, 1:], jnp.zeros((B, 1), ids.dtype)], axis=1)
+        if zig:
+            labels = ids[:, label_map]
+            w = jnp.broadcast_to(jnp.asarray(w_np)[None],
+                                 (B, T)).reshape(-1)
+        else:
+            labels = jnp.concatenate(
+                [ids[:, 1:], jnp.zeros((B, 1), ids.dtype)], axis=1)
+            w = jnp.concatenate(
+                [jnp.ones((B, T - 1)), jnp.zeros((B, 1))],
+                axis=1).reshape(-1)
         nll = optax.softmax_cross_entropy_with_integer_labels(
             logits.reshape(B * T, V), labels.reshape(B * T))
-        w = jnp.concatenate(
-            [jnp.ones((B, T - 1)), jnp.zeros((B, 1))], axis=1).reshape(-1)
         loss = jnp.sum(nll * w) / jnp.sum(w)
         return loss, {"tokens": jnp.sum(w)}
 
@@ -149,7 +187,8 @@ def build_model(cfg: LongContextConfig) -> Model:
         # GSPMD partitions the matmuls and inserts the all-reduce after
         # each row-parallel kernel.
         return Model(
-            init_fn, loss_fn, optimizer=tx, dense_params=("emb",),
+            init_fn, loss_fn, optimizer=tx,
+            dense_params=("emb", "pos"),
             batch_specs={"ids": P(AXIS_REPL, None)},
             param_specs={
                 "blocks/*/wqkv": P(None, AXIS_SHARD),
@@ -159,11 +198,27 @@ def build_model(cfg: LongContextConfig) -> Model:
             })
     if cfg.parallelism == "ring":
         # dp over 'repl', sp over 'shard': [batch, seq] inputs
-        return Model(init_fn, loss_fn, optimizer=tx,
-                     dense_params=("emb",),  # replicated: lookups follow
-                                             # seq-sharded ids, not rows
-                     batch_specs={"ids": P(AXIS_REPL, AXIS_SHARD)})
-    return Model(init_fn, loss_fn, optimizer=tx)
+        model = Model(init_fn, loss_fn, optimizer=tx,
+                      dense_params=("emb", "pos"),  # replicated: lookups follow
+                                              # seq-sharded ids, not rows
+                      batch_specs={"ids": P(AXIS_REPL, AXIS_SHARD)})
+        if cfg.zigzag:
+            def to_zigzag(x, mesh):
+                n = mesh.shape[AXIS_SHARD]
+                if n <= 1:
+                    return x
+                if jax.process_count() > 1:
+                    # each host sees only its local slice; permuting it
+                    # locally would disagree with the global perm the
+                    # loss uses (multi-host zigzag needs a global-aware
+                    # feed transform — ROADMAP)
+                    raise NotImplementedError(
+                        "zigzag placement is single-host for now")
+                return x[:, zigzag_permutation(x.shape[1], n)]
+            model.feed_transforms["ids"] = to_zigzag
+        return model
+    return Model(init_fn, loss_fn, optimizer=tx,
+                 dense_params=("emb", "pos"))
 
 
 def make_batch(rng: np.random.Generator, batch_size: int, seq_len: int,

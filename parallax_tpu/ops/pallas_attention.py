@@ -43,6 +43,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     acc = jnp.zeros((q_tile, D), jnp.float32)
 
     num_k = kv_len // block_k
+    if causal:
+        # K blocks entirely past this q-tile's diagonal are fully
+        # masked — bound the loop instead of masking them
+        num_k = jnp.minimum(
+            num_k, ((qt + 1) * q_tile + block_k - 1) // block_k)
 
     def body(kt, carry):
         m, l, acc = carry
@@ -123,6 +128,9 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     D = q.shape[-1]
     dq = jnp.zeros((q_tile, D), jnp.float32)
     num_k = kv_len // block_k
+    if causal:
+        num_k = jnp.minimum(
+            num_k, ((qt + 1) * q_tile + block_k - 1) // block_k)
 
     def body(kt, dq):
         k_blk = k_ref[0, 0, pl.dslice(kt * block_k, block_k), :]
@@ -159,6 +167,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk = jnp.zeros((k_tile, D), jnp.float32)
     dv = jnp.zeros((k_tile, D), jnp.float32)
     num_q = q_len // q_blk
+    # Q blocks entirely before this k-tile's diagonal see none of it
+    q_lo = (kt * k_tile) // q_blk if causal else 0
 
     def body(qi, carry):
         dk, dv = carry
@@ -189,7 +199,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk, dv
-    dk, dv = jax.lax.fori_loop(0, num_q, body, (dk, dv))
+    dk, dv = jax.lax.fori_loop(q_lo, num_q, body, (dk, dv))
     # q was pre-scaled, so dk absorbed one factor of `scale` already
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)

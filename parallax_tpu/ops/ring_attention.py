@@ -16,13 +16,16 @@ all-to-all sequence-parallel cost model.
 Differentiable: the ring loop is a `lax.scan` (static trip count =
 ring size), so reverse-mode AD threads the same ring backwards.
 
-TODO(perf, round 2): with contiguous sequence placement, causal masking
-discards ~half the score FLOPs (blocks with kv_origin > idx are fully
-masked) and load is imbalanced across the ring (device 0 does the least
-useful work). The fix is striped/zig-zag placement — each device holds a
-low block and a mirrored high block — which balances causal work; it
-changes the input-layout contract so it lands together with an engine-
-level resharding pass.
+Causal placements:
+  * ``placement='contiguous'`` (default): device i holds rows
+    [i·T/n, (i+1)·T/n). Simple layout, but causal masking discards
+    ~half the score FLOPs and device 0 does the least useful work.
+  * ``placement='zigzag'``: device i holds the low block i and the
+    mirrored high block 2n-1-i (each T/2n rows), so every device
+    carries the same causal workload. Inputs must be pre-permuted with
+    `zigzag_permutation` (outputs come back in the same zigzag layout;
+    invert with `inverse_zigzag_permutation`). Engine-level automatic
+    resharding is roadmap item 2.
 """
 
 from __future__ import annotations
@@ -37,11 +40,34 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
+def zigzag_permutation(T: int, n: int) -> np.ndarray:
+    """perm such that zigzag_layout = real[..., perm, ...]: device i's
+    shard is real blocks (i, 2n-1-i), each of T/(2n) rows."""
+    if T % (2 * n):
+        raise ValueError(
+            f"zigzag placement needs sequence length divisible by "
+            f"2*ring={2 * n}; got T={T}")
+    h = T // (2 * n)
+    idx = []
+    for i in range(n):
+        idx.extend(range(i * h, (i + 1) * h))
+        idx.extend(range((2 * n - 1 - i) * h, (2 * n - i) * h))
+    return np.asarray(idx)
+
+
+def inverse_zigzag_permutation(T: int, n: int) -> np.ndarray:
+    perm = zigzag_permutation(T, n)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(T)
+    return inv
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    mesh: Mesh, axis: str,
                    causal: bool = False,
                    scale: Optional[float] = None,
-                   batch_axis: Optional[str] = None) -> jax.Array:
+                   batch_axis: Optional[str] = None,
+                   placement: str = "contiguous") -> jax.Array:
     """Attention with the sequence dimension sharded over ``axis``.
 
     q, k, v: [B, T, H, D] with T sharded over ``axis`` (global views);
@@ -50,13 +76,30 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    if placement not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown placement {placement!r}")
+    zigzag = placement == "zigzag"
     n = mesh.shape[axis]
+    if zigzag and q.shape[1] % (2 * n):
+        raise ValueError(
+            f"zigzag placement needs T divisible by 2*n ({2 * n})")
     spec = P(batch_axis, axis, None, None)
 
     def local(q_loc, k_loc, v_loc):
         # q_loc: [B, Tq, H, D] — this device's query block.
         idx = jax.lax.axis_index(axis)
         B, Tq, H, D = q_loc.shape
+
+        def positions(origin):
+            """Real sequence positions of the block originating on
+            device ``origin`` (traced scalar), length Tq."""
+            if not zigzag:
+                return origin * Tq + jnp.arange(Tq)
+            h = Tq // 2
+            lo = origin * h + jnp.arange(h)
+            hi = (2 * n - 1 - origin) * h + jnp.arange(h)
+            return jnp.concatenate([lo, hi])
+
         qh = (q_loc * scale).transpose(0, 2, 1, 3)        # [B, H, Tq, D]
 
         # mark the accumulators as device-varying over every mesh axis the
@@ -80,9 +123,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 "bhqd,bhkd->bhqk", qh, kh,
                 preferred_element_type=jnp.float32)       # [B,H,Tq,Tk]
             if causal:
-                Tk = kh.shape[2]
-                q_pos = idx * Tq + jnp.arange(Tq)
-                k_pos = kv_origin * Tk + jnp.arange(Tk)
+                q_pos = positions(idx)
+                k_pos = positions(kv_origin)
                 mask = q_pos[:, None] >= k_pos[None, :]
                 scores = jnp.where(mask[None, None], scores, _NEG_INF)
             m_new = jnp.maximum(m, scores.max(axis=-1))
@@ -99,7 +141,19 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
         def step(carry, s):
             k_blk, v_blk, m, l, o = carry
-            m, l, o = accumulate(k_blk, v_blk, s, m, l, o)
+            if causal and not zigzag:
+                # contiguous placement: blocks from later devices are
+                # fully masked — skip their score/accumulate compute
+                # entirely (zigzag blocks are never fully masked; that
+                # is the point of the balanced placement)
+                kv_origin = (idx - s) % n
+                m, l, o = jax.lax.cond(
+                    kv_origin <= idx,
+                    lambda a: accumulate(*a),
+                    lambda a: (a[3], a[4], a[5]),
+                    (k_blk, v_blk, s, m, l, o))
+            else:
+                m, l, o = accumulate(k_blk, v_blk, s, m, l, o)
             # rotate the K/V block around the ring
             perm = [(i, (i + 1) % n) for i in range(n)]
             k_blk = jax.lax.ppermute(k_blk, axis, perm)

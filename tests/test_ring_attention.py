@@ -67,3 +67,53 @@ def test_bf16_inputs(qkv):
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(expected, np.float32),
         rtol=0.05, atol=0.05)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_zigzag_placement_matches_full_attention(qkv, n):
+    """Balanced causal placement: permute in, compute, invert out."""
+    q, k, v = qkv
+    mesh = _seq_mesh(n)
+    perm = ra.zigzag_permutation(T, n)
+    inv = ra.inverse_zigzag_permutation(T, n)
+    expected = ra.full_attention_reference(q, k, v, causal=True)
+    out_z = jax.jit(lambda q, k, v: ra.ring_attention(
+        q, k, v, mesh, "seq", causal=True, placement="zigzag"))(
+        q[:, perm], k[:, perm], v[:, perm])
+    got = out_z[:, inv]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_zigzag_gradients_match(qkv):
+    q, k, v = qkv
+    n = 4
+    mesh = _seq_mesh(n)
+    perm = ra.zigzag_permutation(T, n)
+    inv = ra.inverse_zigzag_permutation(T, n)
+    g_out = jnp.asarray(np.random.default_rng(11).standard_normal(
+        (B, T, H, D)).astype(np.float32))
+
+    def zig_loss(q, k, v):
+        out = ra.ring_attention(q[:, perm], k[:, perm], v[:, perm],
+                                mesh, "seq", causal=True,
+                                placement="zigzag")[:, inv]
+        return jnp.sum(out * g_out)
+
+    def full_loss(q, k, v):
+        return jnp.sum(ra.full_attention_reference(q, k, v, causal=True)
+                       * g_out)
+
+    got = jax.jit(jax.grad(zig_loss, argnums=(0, 1, 2)))(q, k, v)
+    expected = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(got, expected, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-6, err_msg=name)
+
+
+def test_zigzag_requires_divisible_T(qkv):
+    q, k, v = qkv
+    mesh = _seq_mesh(8)
+    with pytest.raises(ValueError, match="zigzag"):
+        ra.ring_attention(q[:, :24], k[:, :24], v[:, :24], mesh, "seq",
+                          causal=True, placement="zigzag")
