@@ -81,15 +81,17 @@ def main():
     try_bs = small_bs
     while vs_baseline is None and try_bs >= n_chips:
         try:
-            sampled_small = _run(lm1b.build_model(cfg), cfg, try_bs, T,
-                                 max(5, steps // 3), warmup, "HYBRID")
+            # the OOM-prone full-softmax model goes first so a failed
+            # size doesn't waste a measured sampled run
             full_small = _run(lm1b.build_full_softmax_model(cfg), cfg,
                               try_bs, T, max(5, steps // 3), warmup,
                               "HYBRID")
+            sampled_small = _run(lm1b.build_model(cfg), cfg, try_bs, T,
+                                 max(5, steps // 3), warmup, "HYBRID")
             vs_baseline = sampled_small / full_small
         except Exception as e:  # typically RESOURCE_EXHAUSTED
-            print(f"# baseline at bs={try_bs} failed ({type(e).__name__})",
-                  flush=True)
+            print(f"# baseline at bs={try_bs} failed: "
+                  f"{type(e).__name__}: {str(e)[:300]}", flush=True)
             try_bs //= 2
     # vs_baseline stays None (JSON null) if the baseline never ran —
     # never fabricate a parity number

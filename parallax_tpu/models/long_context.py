@@ -211,7 +211,10 @@ def build_model(cfg: LongContextConfig) -> Model:
                     # each host sees only its local slice; permuting it
                     # locally would disagree with the global perm the
                     # loss uses (multi-host zigzag needs a global-aware
-                    # feed transform — ROADMAP)
+                    # feed transform — ROADMAP). Checked here and not at
+                    # build_model time because the model is typically
+                    # built before jax.distributed initializes, when
+                    # process_count still reads 1.
                     raise NotImplementedError(
                         "zigzag placement is single-host for now")
                 return x[:, zigzag_permutation(x.shape[1], n)]
