@@ -50,12 +50,27 @@ class ProfileHook:
         return os.path.join(self._config.profile_dir, socket.gethostname(),
                             f"worker_{self._worker_id}")
 
+    def _append_task_info(self, path: str) -> None:
+        """Task manifest parity (reference lib.py:333-358): one
+        ``<profile_dir>/<hostname>/task_info`` line per worker process,
+        written once, per-host file so multi-host runs never share an
+        append target."""
+        if getattr(self, "_manifest_written", False):
+            return
+        self._manifest_written = True
+        manifest = os.path.join(self._config.profile_dir,
+                                socket.gethostname(), "task_info")
+        with open(manifest, "a") as f:
+            f.write(f"worker:{self._worker_id} "
+                    f"devices:{jax.local_device_count()} dir:{path}\n")
+
     def before_step(self, step: int) -> None:
         if not self._enabled or self._tracing:
             return
         if self._is_profile_step(step):
             path = self._trace_dir()
             os.makedirs(path, exist_ok=True)
+            self._append_task_info(path)
             jax.profiler.start_trace(path)
             self._tracing = True
             parallax_log.info("profiling step %d -> %s", step, path)
