@@ -35,6 +35,9 @@ class BertConfig:
     max_len: int = 512
     type_vocab: int = 2
     learning_rate: float = 1e-4
+    # fuse attention (incl. the WordPiece padding mask) with the Pallas
+    # flash kernel
+    use_pallas_attention: bool = False
     num_partitions: Optional[int] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
 
@@ -97,6 +100,13 @@ def build_model(cfg: BertConfig) -> Model:
         hd = D // Hn
         qkv = x @ p["wqkv"].astype(dt)
         q, k, v = jnp.split(qkv, 3, -1)
+
+        if cfg.use_pallas_attention:
+            from parallax_tpu.ops.pallas_attention import flash_attention
+            out = flash_attention(
+                q.reshape(B, T, Hn, hd), k.reshape(B, T, Hn, hd),
+                v.reshape(B, T, Hn, hd), kv_mask=pad_mask)
+            return out.reshape(B, T, D) @ p["wo"].astype(dt)
 
         def heads(z):
             return z.reshape(B, T, Hn, hd).transpose(0, 2, 1, 3)

@@ -30,9 +30,13 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                      kv_len: int, block_k: int, causal: bool,
-                      scale: float, q_tile: int):
+def _flash_fwd_kernel(*refs, kv_len: int, block_k: int, causal: bool,
+                      scale: float, q_tile: int, has_mask: bool):
+    if has_mask:
+        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        mask_ref = None
     # q_ref: [q_tile, D]; k_ref/v_ref: [Tk, D]; o_ref: [q_tile, D]
     qt = pl.program_id(2)
     q = q_ref[0, 0] * scale                                # [q_tile, D]
@@ -56,6 +60,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # [q_tile, bk]
+        if mask_ref is not None:
+            kv_ok = mask_ref[0, pl.dslice(kt * block_k, block_k)]
+            s = jnp.where(kv_ok[None, :] > 0, s, _NEG_INF)
         if causal:
             q_pos = qt * q_tile + jax.lax.broadcasted_iota(
                 jnp.int32, (q_tile, block_k), 0)
@@ -84,26 +91,32 @@ def _snap(tile, total):
     return max(tile, 1)
 
 
-def _flash_forward(q, k, v, causal: bool, scale: float,
+def _flash_forward(q, k, v, kv_mask, causal: bool, scale: float,
                    q_tile: int, block_k: int, interpret: bool):
-    """q, k, v: [B, H, T, D] -> (out [B, H, T, D], lse [B, H, T])."""
+    """q, k, v: [B, H, T, D]; kv_mask: [B, Tk] int32 (1 = attendable).
+    Returns (out [B, H, T, D], lse [B, H, T])."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     q_tile = _snap(q_tile, Tq)
     block_k = _snap(block_k, Tk)
     grid = (B, H, Tq // q_tile)
+    has_mask = kv_mask is not None
     kernel = functools.partial(
         _flash_fwd_kernel, kv_len=Tk, block_k=block_k, causal=causal,
-        scale=scale, q_tile=q_tile)
+        scale=scale, q_tile=q_tile, has_mask=has_mask)
+    in_specs = [
+        pl.BlockSpec((1, 1, q_tile, D), lambda b, h, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+    ]
+    operands = [q, k, v]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, Tk), lambda b, h, i: (b, 0)))
+        operands.append(kv_mask)
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, q_tile, D),
-                         lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, q_tile, D),
                          lambda b, h, i: (b, h, i, 0)),
@@ -114,12 +127,17 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
             jax.ShapeDtypeStruct((B, H, Tq), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dq_ref, *, kv_len: int, block_k: int, causal: bool,
-                     scale: float, q_tile: int):
+def _flash_dq_kernel(*refs, kv_len: int, block_k: int, causal: bool,
+                     scale: float, q_tile: int, has_mask: bool):
+    if has_mask:
+        (q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+         dq_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
+        mask_ref = None
     qt = pl.program_id(2)
     q = q_ref[0, 0] * scale                                # [qt, D]
     do = do_ref[0, 0].astype(jnp.float32)                  # [qt, D]
@@ -138,6 +156,9 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [qt, bk]
+        if mask_ref is not None:
+            kv_ok = mask_ref[0, pl.dslice(kt * block_k, block_k)]
+            s = jnp.where(kv_ok[None, :] > 0, s, _NEG_INF)
         if causal:
             q_pos = qt * q_tile + jax.lax.broadcasted_iota(
                 jnp.int32, (q_tile, block_k), 0)
@@ -157,9 +178,15 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, *, q_len: int, q_blk: int,
-                      causal: bool, scale: float, k_tile: int):
+def _flash_dkv_kernel(*refs, q_len: int, q_blk: int, causal: bool,
+                      scale: float, k_tile: int, has_mask: bool):
+    if has_mask:
+        (q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+         dv_ref) = refs
+        mask_ref = None
     kt = pl.program_id(2)
     k = k_ref[0, 0]                                        # [kt_, D]
     v = v_ref[0, 0].astype(jnp.float32)
@@ -180,6 +207,9 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [qb, kt_]
+        if mask_ref is not None:
+            kv_ok = mask_ref[0, :]
+            s = jnp.where(kv_ok[None, :] > 0, s, _NEG_INF)
         if causal:
             q_pos = qi * q_blk + jax.lax.broadcasted_iota(
                 jnp.int32, (q_blk, k_tile), 0)
@@ -205,8 +235,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, scale, q_tile,
-                    block_k, interpret):
+def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
+                    q_tile, block_k, interpret):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     q_tile = _snap(q_tile, Tq)
@@ -214,38 +244,56 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, q_tile,
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                               # [B, H, Tq]
 
+    has_mask = kv_mask is not None
+    dq_specs = [
+        pl.BlockSpec((1, 1, q_tile, D), lambda b, h, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
+    ]
+    dq_operands = [q, k, v]
+    if has_mask:
+        dq_specs.append(pl.BlockSpec((1, Tk), lambda b, h, i: (b, 0)))
+        dq_operands.append(kv_mask)
+    dq_specs += [
+        pl.BlockSpec((1, 1, q_tile, D), lambda b, h, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, q_tile), lambda b, h, i: (b, h, i)),
+        pl.BlockSpec((1, 1, q_tile), lambda b, h, i: (b, h, i)),
+    ]
+    dq_operands += [g, lse, delta]
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, kv_len=Tk, block_k=block_k,
-                          causal=causal, scale=scale, q_tile=q_tile),
+                          causal=causal, scale=scale, q_tile=q_tile,
+                          has_mask=has_mask),
         grid=(B, H, Tq // q_tile),
-        in_specs=[
-            pl.BlockSpec((1, 1, q_tile, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, q_tile, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, q_tile), lambda b, h, i: (b, h, i)),
-            pl.BlockSpec((1, 1, q_tile), lambda b, h, i: (b, h, i)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, q_tile, D),
                                lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dq_operands)
 
+    dkv_specs = [
+        pl.BlockSpec((1, 1, Tq, D), lambda b, h, j: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0)),
+    ]
+    dkv_operands = [q, k, v]
+    if has_mask:
+        dkv_specs.append(pl.BlockSpec((1, block_k),
+                                      lambda b, h, j: (b, j)))
+        dkv_operands.append(kv_mask)
+    dkv_specs += [
+        pl.BlockSpec((1, 1, Tq, D), lambda b, h, j: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, Tq), lambda b, h, j: (b, h, 0)),
+        pl.BlockSpec((1, 1, Tq), lambda b, h, j: (b, h, 0)),
+    ]
+    dkv_operands += [g, lse, delta]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, q_len=Tq, q_blk=q_tile,
-                          causal=causal, scale=scale, k_tile=block_k),
+                          causal=causal, scale=scale, k_tile=block_k,
+                          has_mask=has_mask),
         grid=(B, H, Tk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, 1, Tq, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, Tq, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, Tq), lambda b, h, j: (b, h, 0)),
-            pl.BlockSpec((1, 1, Tq), lambda b, h, j: (b, h, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, j: (b, h, j, 0)),
@@ -257,18 +305,23 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, q_tile,
             jax.ShapeDtypeStruct((B, H, Tk, D), v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dkv_operands)
     return dq, dk, dv
 
 
-def _xla_attention(q, k, v, causal, scale):
+def _xla_attention(q, k, v, kv_mask, causal, scale):
     s = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k,
                    preferred_element_type=jnp.float32)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :] > 0, s, _NEG_INF)
     if causal:
         T, Tk = q.shape[2], k.shape[2]
         mask = jnp.tril(jnp.ones((T, Tk), bool))
         s = jnp.where(mask[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows: zero the uniform softmax so outputs and grads
+    # match the Pallas kernels (which emit exact zeros there)
+    p = jnp.where(s > _NEG_INF / 2, p, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
 
@@ -276,15 +329,15 @@ def _xla_attention(q, k, v, causal, scale):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_attention(q, k, v, causal, scale, q_tile, block_k, interpret,
                      xla_backward):
-    out, _ = _flash_forward(q, k, v, causal, scale, q_tile, block_k,
-                            interpret)
+    out, _ = _flash_forward(q, k, v, None, causal, scale, q_tile,
+                            block_k, interpret)
     return out
 
 
 def _fwd(q, k, v, causal, scale, q_tile, block_k, interpret,
          xla_backward):
-    out, lse = _flash_forward(q, k, v, causal, scale, q_tile, block_k,
-                              interpret)
+    out, lse = _flash_forward(q, k, v, None, causal, scale, q_tile,
+                              block_k, interpret)
     return out, (q, k, v, out, lse)
 
 
@@ -292,35 +345,80 @@ def _bwd(causal, scale, q_tile, block_k, interpret, xla_backward, res,
          g):
     q, k, v, out, lse = res
     if xla_backward:
-        _, vjp = jax.vjp(lambda q, k, v: _xla_attention(q, k, v, causal,
-                                                        scale), q, k, v)
+        _, vjp = jax.vjp(
+            lambda q, k, v: _xla_attention(q, k, v, None, causal,
+                                           scale), q, k, v)
         return vjp(g)
-    return _flash_backward(q, k, v, out, lse, g, causal, scale, q_tile,
-                           block_k, interpret)
+    return _flash_backward(q, k, v, None, out, lse, g, causal, scale,
+                           q_tile, block_k, interpret)
 
 
 _flash_attention.defvjp(_fwd, _bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_attention_masked(q, k, v, kv_mask, causal, scale, q_tile,
+                            block_k, interpret, xla_backward):
+    out, _ = _flash_forward(q, k, v, kv_mask, causal, scale, q_tile,
+                            block_k, interpret)
+    return out
+
+
+def _fwd_masked(q, k, v, kv_mask, causal, scale, q_tile, block_k,
+                interpret, xla_backward):
+    out, lse = _flash_forward(q, k, v, kv_mask, causal, scale, q_tile,
+                              block_k, interpret)
+    return out, (q, k, v, kv_mask, out, lse)
+
+
+def _bwd_masked(causal, scale, q_tile, block_k, interpret, xla_backward,
+                res, g):
+    q, k, v, kv_mask, out, lse = res
+    if xla_backward:
+        _, vjp = jax.vjp(
+            lambda q, k, v: _xla_attention(q, k, v, kv_mask, causal,
+                                           scale), q, k, v)
+        dq, dk, dv = vjp(g)
+    else:
+        dq, dk, dv = _flash_backward(q, k, v, kv_mask, out, lse, g,
+                                     causal, scale, q_tile, block_k,
+                                     interpret)
+    mask_ct = np.zeros(kv_mask.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, mask_ct
+
+
+_flash_attention_masked.defvjp(_fwd_masked, _bwd_masked)
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False,
                     scale: Optional[float] = None,
+                    kv_mask: Optional[jax.Array] = None,
                     q_tile: int = 256, block_k: int = 256,
                     interpret: Optional[bool] = None,
                     xla_backward: bool = False) -> jax.Array:
     """Fused attention: q, k, v [B, T, H, D] -> [B, T, H, D].
 
-    ``interpret`` defaults to True off-TPU (so CPU tests exercise the
-    same kernels) and False on TPU. ``xla_backward=True`` swaps the
-    Pallas backward kernels for the einsum-recompute fallback.
+    ``kv_mask`` [B, Tk] marks attendable key positions (padding mask for
+    NMT/BERT-style models); None means all keys attend. ``interpret``
+    defaults to True off-TPU (so CPU tests exercise the same kernels)
+    and False on TPU. ``xla_backward=True`` swaps the Pallas backward
+    kernels for the einsum-recompute fallback.
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if kv_mask is not None:
+        kv_mask = kv_mask.astype(jnp.int32)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash_attention(qt, kt, vt, causal, float(scale), q_tile,
-                           block_k, interpret, xla_backward)
+    if kv_mask is None:
+        out = _flash_attention(qt, kt, vt, causal, float(scale), q_tile,
+                               block_k, interpret, xla_backward)
+    else:
+        out = _flash_attention_masked(qt, kt, vt, kv_mask, causal,
+                                      float(scale), q_tile, block_k,
+                                      interpret, xla_backward)
     return out.transpose(0, 2, 1, 3)

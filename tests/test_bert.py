@@ -28,3 +28,25 @@ def test_classification_and_training(rng):
     assert last < first * 0.9, (first, last)
     assert np.isfinite(last)
     sess.close()
+
+
+def test_pallas_attention_matches_xla_path(rng):
+    """BERT with the Pallas flash kernel (padding mask included) tracks
+    the XLA attention trajectory."""
+    batches = [bert.make_batch(rng, 16, 16, 4, 500) for _ in range(3)]
+    # pad some tokens so the mask actually matters
+    for b in batches:
+        b["input_ids"][:, -3:] = 0
+
+    def run(use_pallas):
+        cfg = bert.tiny_config(num_partitions=8, learning_rate=1e-3,
+                               use_pallas_attention=use_pallas)
+        sess, *_ = parallax.parallel_run(
+            bert.build_model(cfg),
+            parallax_config=parallax.Config(run_option="HYBRID",
+                                            search_partitions=False))
+        losses = [sess.run("loss", feed_dict=b) for b in batches]
+        sess.close()
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-3)

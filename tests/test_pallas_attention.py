@@ -111,3 +111,33 @@ def test_pallas_backward_matches_xla_backward(qkv, causal):
     for a, b, name in zip(pallas_grads, xla_grads, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-5, atol=5e-6, err_msg=name)
+
+
+def test_kv_padding_mask(qkv):
+    """Padding mask: masked keys get zero attention, grads flow."""
+    q, k, v = qkv
+    rng2 = np.random.default_rng(13)
+    mask = jnp.asarray(rng2.integers(0, 2, (B, T)), jnp.int32
+                       ).at[:, 0].set(1)  # keep >=1 key valid per row
+
+    def xla_ref(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q / np.sqrt(D), k)
+        s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    expected = xla_ref(q, k, v)
+    got = pa.flash_attention(q, k, v, kv_mask=mask, q_tile=16,
+                             block_k=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-6)
+
+    g = jnp.ones((B, T, H, D))
+    grads_p = jax.grad(lambda q, k, v: jnp.sum(pa.flash_attention(
+        q, k, v, kv_mask=mask, q_tile=16, block_k=16) * g),
+        argnums=(0, 1, 2))(q, k, v)
+    grads_x = jax.grad(lambda q, k, v: jnp.sum(xla_ref(q, k, v) * g),
+                       argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(grads_p, grads_x, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-6, err_msg=name)
