@@ -27,9 +27,11 @@ def main():
     ap.add_argument("--partitions", type=int, default=None,
                     help="shard-axis size (sp or tp degree)")
     ap.add_argument("--parallelism", default="ring",
-                    choices=["ring", "tensor", "data"],
+                    choices=["ring", "tensor", "pipeline", "data"],
                     help="ring=sequence parallel, tensor=Megatron TP, "
-                         "data=pure dp")
+                         "pipeline=GPipe stages, data=pure dp")
+    ap.add_argument("--num_microbatches", type=int, default=4,
+                    help="pipeline mode microbatches")
     ap.add_argument("--pallas_attention", action="store_true",
                     help="fuse attention with the Pallas flash kernel "
                          "(data/tensor modes)")
@@ -43,6 +45,7 @@ def main():
                                max_len=args.seq_len,
                                parallelism=args.parallelism,
                                zigzag=args.zigzag,
+                               num_microbatches=args.num_microbatches,
                                use_pallas_attention=args.pallas_attention)
     sess, _, worker_id, _ = parallax.parallel_run(
         lc.build_model(cfg), args.resource_info,

@@ -38,12 +38,16 @@ class LongContextConfig:
     num_layers: int = 6
     max_len: int = 32768
     learning_rate: float = 3e-4
-    # 'ring'  : sequence parallelism — seq dim over 'shard', ring attention
-    # 'tensor': tensor parallelism — Megatron-style column/row-parallel
-    #           kernels over 'shard' (GSPMD inserts the psum after the
-    #           row-parallel matmul), batch data-parallel over 'repl'
-    # 'data'  : pure data parallelism (attention unsharded)
+    # 'ring'    : sequence parallelism — seq over 'shard', ring attention
+    # 'tensor'  : tensor parallelism — Megatron column/row-parallel
+    #             kernels over 'shard' (GSPMD inserts the psum after the
+    #             row-parallel matmul), batch data-parallel over 'repl'
+    # 'pipeline': pipeline parallelism — layer stages over 'shard',
+    #             GPipe microbatch pipelining (ops/pipeline.py), batch
+    #             data-parallel over 'repl'
+    # 'data'    : pure data parallelism (attention unsharded)
     parallelism: str = "ring"
+    num_microbatches: int = 4  # pipeline mode
     # zig-zag sequence placement in ring mode: balances the causal
     # workload across the ring (each device holds a low block and its
     # mirrored high block); the engine permutes the fed ids host-side
@@ -96,12 +100,18 @@ def build_model(cfg: LongContextConfig) -> Model:
                 "ln1": {"s": jnp.ones((D,)), "b": jnp.zeros((D,))},
                 "ln2": {"s": jnp.ones((D,)), "b": jnp.zeros((D,))},
             })
-        return {
+        params = {
             "emb": jax.random.normal(ks[0], (V, D)) * 0.02,
             "pos": jax.random.normal(ks[-1], (cfg.max_len, D)) * 0.02,
             "out_w": dense_init(ks[1], (D, V)),
-            "blocks": blocks,
         }
+        if cfg.parallelism == "pipeline":
+            # stacked layout [L, ...] so layer stages shard over 'shard'
+            params["blocks_stacked"] = jax.tree.map(
+                lambda *leaves: jnp.stack(leaves), *blocks)
+        else:
+            params["blocks"] = blocks
+        return params
 
     def layer_norm(x, s, b):
         m = jnp.mean(x, -1, keepdims=True)
@@ -152,13 +162,48 @@ def build_model(cfg: LongContextConfig) -> Model:
 
         x = emb_ops.embedding_lookup(params["emb"], ids).astype(dt)
         x = x + params["pos"][pos_rows].astype(dt)[None]
-        for p in params["blocks"]:
+
+        def block_apply(p, x):
             ln = p["ln1"]
             x = x + attention(
                 layer_norm(x, ln["s"].astype(dt), ln["b"].astype(dt)), p)
             ln = p["ln2"]
             h = layer_norm(x, ln["s"].astype(dt), ln["b"].astype(dt))
-            x = x + jax.nn.relu(h @ p["w1"].astype(dt)) @ p["w2"].astype(dt)
+            return x + (jax.nn.relu(h @ p["w1"].astype(dt))
+                        @ p["w2"].astype(dt))
+
+        if "blocks_stacked" in params:
+            from parallax_tpu.ops.pipeline import pipeline_apply
+            stacked = params["blocks_stacked"]
+            n_stages = (mesh.shape[AXIS_SHARD]
+                        if mesh is not None else 1)
+            if mesh is None or n_stages == 1:
+                for i in range(cfg.num_layers):
+                    x = block_apply(
+                        jax.tree.map(lambda p: p[i], stacked), x)
+            else:
+                if cfg.num_layers % n_stages:
+                    raise ValueError(
+                        f"pipeline parallelism needs num_layers "
+                        f"({cfg.num_layers}) divisible by the "
+                        f"{n_stages}-stage shard axis")
+                per_stage = cfg.num_layers // n_stages
+
+                def stage_fn(stage_params, x):
+                    # stage_params leaves: [per_stage, ...]
+                    for j in range(per_stage):
+                        x = block_apply(
+                            jax.tree.map(lambda p: p[j], stage_params), x)
+                    return x
+
+                staged = jax.tree.map(
+                    lambda p: p.reshape((n_stages, per_stage)
+                                        + p.shape[1:]), stacked)
+                x = pipeline_apply(stage_fn, staged, x, mesh,
+                                   cfg.num_microbatches)
+        else:
+            for p in params["blocks"]:
+                x = block_apply(p, x)
         logits = x.astype(jnp.float32) @ params["out_w"]
         if zig:
             labels = ids[:, label_map]
@@ -175,12 +220,20 @@ def build_model(cfg: LongContextConfig) -> Model:
         loss = jnp.sum(nll * w) / jnp.sum(w)
         return loss, {"tokens": jnp.sum(w)}
 
-    if cfg.parallelism not in ("ring", "tensor", "data"):
+    if cfg.parallelism not in ("ring", "tensor", "pipeline", "data"):
         raise ValueError(
             f"unknown parallelism {cfg.parallelism!r}; expected "
-            f"'ring', 'tensor' or 'data'")
+            f"'ring', 'tensor', 'pipeline' or 'data'")
     tx = optax.chain(optax.clip_by_global_norm(1.0),
                      optax.adam(cfg.learning_rate))
+    if cfg.parallelism == "pipeline":
+        # layer stages over 'shard' (each device owns num_layers/S
+        # layers), microbatch pipelining; batch dp over 'repl'
+        return Model(
+            init_fn, loss_fn, optimizer=tx,
+            dense_params=("emb", "pos"),
+            batch_specs={"ids": P(AXIS_REPL, None)},
+            param_specs={"blocks_stacked/*": P(AXIS_SHARD)})
     if cfg.parallelism == "tensor":
         # Megatron-style TP: qkv/up-proj column-parallel, out/down-proj
         # row-parallel over 'shard'; batch data-parallel over 'repl'.

@@ -326,36 +326,6 @@ def _xla_attention(q, k, v, kv_mask, causal, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_attention(q, k, v, causal, scale, q_tile, block_k, interpret,
-                     xla_backward):
-    out, _ = _flash_forward(q, k, v, None, causal, scale, q_tile,
-                            block_k, interpret)
-    return out
-
-
-def _fwd(q, k, v, causal, scale, q_tile, block_k, interpret,
-         xla_backward):
-    out, lse = _flash_forward(q, k, v, None, causal, scale, q_tile,
-                              block_k, interpret)
-    return out, (q, k, v, out, lse)
-
-
-def _bwd(causal, scale, q_tile, block_k, interpret, xla_backward, res,
-         g):
-    q, k, v, out, lse = res
-    if xla_backward:
-        _, vjp = jax.vjp(
-            lambda q, k, v: _xla_attention(q, k, v, None, causal,
-                                           scale), q, k, v)
-        return vjp(g)
-    return _flash_backward(q, k, v, None, out, lse, g, causal, scale,
-                           q_tile, block_k, interpret)
-
-
-_flash_attention.defvjp(_fwd, _bwd)
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def _flash_attention_masked(q, k, v, kv_mask, causal, scale, q_tile,
                             block_k, interpret, xla_backward):
@@ -383,7 +353,8 @@ def _bwd_masked(causal, scale, q_tile, block_k, interpret, xla_backward,
         dq, dk, dv = _flash_backward(q, k, v, kv_mask, out, lse, g,
                                      causal, scale, q_tile, block_k,
                                      interpret)
-    mask_ct = np.zeros(kv_mask.shape, dtype=jax.dtypes.float0)
+    mask_ct = (None if kv_mask is None else
+               np.zeros(kv_mask.shape, dtype=jax.dtypes.float0))
     return dq, dk, dv, mask_ct
 
 
@@ -414,11 +385,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    if kv_mask is None:
-        out = _flash_attention(qt, kt, vt, causal, float(scale), q_tile,
-                               block_k, interpret, xla_backward)
-    else:
-        out = _flash_attention_masked(qt, kt, vt, kv_mask, causal,
-                                      float(scale), q_tile, block_k,
-                                      interpret, xla_backward)
+    out = _flash_attention_masked(qt, kt, vt, kv_mask, causal,
+                                  float(scale), q_tile, block_k,
+                                  interpret, xla_backward)
     return out.transpose(0, 2, 1, 3)

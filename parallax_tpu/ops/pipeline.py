@@ -1,0 +1,107 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over the mesh.
+
+Absent from the reference (SURVEY.md §2.5) and from round-1 scope until
+now: layer *stages* are sharded over the ``'shard'`` axis (stage s's
+parameters live only on device s via a stacked leading axis), and
+microbatches flow through the stage ring with one `ppermute` hop per
+tick. All devices execute the same SPMD program; a device is "active"
+for tick t iff its stage s has a microbatch in flight (0 <= t - s < M).
+
+Differentiable end-to-end: the tick loop is a `lax.scan` and activation
+hops are `ppermute`, both transposable, so reverse-mode AD runs the
+pipeline backwards (the 1F1B-style backward schedule emerges from the
+transpose).
+
+Cost model: wall-clock ticks = M + S - 1 (bubble fraction
+(S-1)/(M+S-1)); per-tick comm = one activation microbatch per ICI hop.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD
+
+
+def pipeline_apply(stage_fn: Callable,
+                   stage_params,
+                   x: jax.Array,
+                   mesh: Mesh,
+                   num_microbatches: int) -> jax.Array:
+    """Run ``x`` through S pipelined stages.
+
+    * ``stage_fn(params_one_stage, activation) -> activation`` — one
+      stage's computation; activation shapes must match across stages.
+    * ``stage_params`` — pytree whose leaves have a leading stage axis
+      [S, ...], sharded P('shard', ...) so each device owns its stage.
+    * ``x`` — [B, ...] batch (replicated over 'shard'; 'repl' may carry
+      data parallelism on dim 0). B must divide into
+      ``num_microbatches``.
+
+    Returns [B, ...] outputs (replicated over 'shard').
+    """
+    S = mesh.shape[AXIS_SHARD]
+    M = num_microbatches
+    B = x.shape[0]
+    repl = mesh.shape[AXIS_REPL]
+    if (B // max(repl, 1)) % M or B % max(repl, 1):
+        raise ValueError(
+            f"per-replica batch {B}/{repl} must be divisible by "
+            f"num_microbatches={M}")
+
+    def local(params_local, x_local):
+        # params_local leaves: [1, ...] (this device's stage);
+        # x_local: [B/repl, ...] — full batch slice for this repl row.
+        s = jax.lax.axis_index(AXIS_SHARD)
+        mb = x_local.shape[0] // M
+        xm = x_local.reshape((M, mb) + x_local.shape[1:])
+        my_params = jax.tree.map(lambda p: p[0], params_local)
+
+        act0 = jnp.zeros_like(xm[0])
+        outs0 = jax.lax.pcast(
+            jnp.zeros_like(xm), (AXIS_SHARD,), to="varying")
+        act0 = jax.lax.pcast(act0, (AXIS_SHARD,), to="varying")
+
+        def tick(carry, t):
+            act, outs = carry
+            m = t - s                       # microbatch index at stage s
+            active = (m >= 0) & (m < M)
+            m_safe = jnp.clip(m, 0, M - 1)
+            # stage 0 pulls fresh input; later stages use the received
+            # activation
+            inp = jnp.where(s == 0, jax.lax.dynamic_index_in_dim(
+                xm, m_safe, axis=0, keepdims=False), act)
+            out = stage_fn(my_params, inp)
+            out = jnp.where(active, out, jnp.zeros_like(out))
+            # last stage records its finished microbatch
+            record = (s == S - 1) & active
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(record,
+                                out,
+                                jax.lax.dynamic_index_in_dim(
+                                    outs, m_safe, 0, keepdims=False)),
+                m_safe, axis=0)
+            # hop to the next stage
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            act_next = jax.lax.ppermute(out, AXIS_SHARD, perm)
+            return (act_next, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (act0, outs0),
+                                    jnp.arange(M + S - 1))
+        # only the last stage holds real outputs; broadcast them
+        outs = jnp.where(s == S - 1, outs, jnp.zeros_like(outs))
+        outs = jax.lax.psum(outs, AXIS_SHARD)
+        return outs.reshape(x_local.shape)
+
+    spec_params = jax.tree.map(
+        lambda p: P(*((AXIS_SHARD,) + (None,) * (p.ndim - 1))),
+        stage_params)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(spec_params, P(AXIS_REPL)),
+        out_specs=P(AXIS_REPL),
+    )(stage_params, x)
