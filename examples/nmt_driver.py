@@ -26,6 +26,9 @@ def main():
     ap.add_argument("--log_frequency", type=int, default=10)
     ap.add_argument("--run_option", default="HYBRID")
     ap.add_argument("--partitions", type=int, default=None)
+    ap.add_argument("--pallas_attention", action="store_true",
+                    help="fuse all three attention types with the "
+                         "Pallas flash kernels")
     args = ap.parse_args()
 
     num_partitions = parallax.get_partitioner(args.partitions)
@@ -33,6 +36,7 @@ def main():
                         model_dim=args.model_dim,
                         num_layers=args.num_layers,
                         max_len=max(args.src_len, args.tgt_len),
+                        use_pallas_attention=args.pallas_attention,
                         num_partitions=num_partitions)
     sess, num_workers, worker_id, _ = parallax.parallel_run(
         nmt.build_model(cfg), args.resource_info,

@@ -36,6 +36,7 @@ class MoeLMConfig:
     max_len: int = 1024
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    use_pallas_attention: bool = False
     learning_rate: float = 3e-4
     num_partitions: Optional[int] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
@@ -97,8 +98,13 @@ def build_model(cfg: MoeLMConfig) -> Model:
         def heads(z):
             return z.reshape(B, T, Hn, D // Hn)
 
-        out = full_attention_reference(heads(q), heads(k), heads(v),
-                                       causal=True)
+        if cfg.use_pallas_attention:
+            from parallax_tpu.ops.pallas_attention import flash_attention
+            out = flash_attention(heads(q), heads(k), heads(v),
+                                  causal=True)
+        else:
+            out = full_attention_reference(heads(q), heads(k), heads(v),
+                                           causal=True)
         return out.reshape(B, T, D) @ p["wo"].astype(dt)
 
     def loss_fn(params, batch, rng):

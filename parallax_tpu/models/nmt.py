@@ -44,6 +44,9 @@ class NMTConfig:
     label_smoothing: float = 0.1
     learning_rate: float = 1e-3
     warmup_steps: int = 4000
+    # fuse all three attention types (enc self w/ pad mask, causal dec
+    # self, cross w/ src pad mask) with the Pallas flash kernels
+    use_pallas_attention: bool = False
     num_partitions: Optional[int] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
 
@@ -89,6 +92,20 @@ def build_model(cfg: NMTConfig) -> Model:
     V, D = cfg.padded_vocab, cfg.model_dim
     dt = cfg.compute_dtype
 
+    def fused_attention(q, k, v, *, causal=False, kv_mask=None):
+        """Pallas flash attention on [B, T, D] projections split into
+        heads; covers all three NMT attention patterns."""
+        from parallax_tpu.ops.pallas_attention import flash_attention
+        B, Tq, _ = q.shape
+        Tk = k.shape[1]
+        h = cfg.num_heads
+        hd = D // h
+        out = flash_attention(q.reshape(B, Tq, h, hd),
+                              k.reshape(B, Tk, h, hd),
+                              v.reshape(B, Tk, h, hd),
+                              causal=causal, kv_mask=kv_mask)
+        return out.reshape(B, Tq, D)
+
     def dense_init(rng, shape):
         return jax.random.normal(rng, shape) * (1.0 / np.sqrt(shape[0]))
 
@@ -121,18 +138,35 @@ def build_model(cfg: NMTConfig) -> Model:
             "out_proj": dense_init(ks[-1], (D, V)),
         }
 
-    def self_block(p, x, mask, cross_kv=None, cross_mask=None):
+    def attend(x_q, x_kv, w, *, causal=False, kv_mask=None):
+        """One attention with a single (causal, kv_mask) description;
+        the XLA branch derives its dense mask from it."""
+        q = x_q @ w["wq"].astype(dt)
+        k = x_kv @ w["wk"].astype(dt)
+        v = x_kv @ w["wv"].astype(dt)
+        if cfg.use_pallas_attention:
+            return fused_attention(q, k, v, causal=causal,
+                                   kv_mask=kv_mask)
+        Tq, Tk = q.shape[1], k.shape[1]
+        mask = None
+        if kv_mask is not None:
+            mask = kv_mask[:, None, None, :]
+        if causal:
+            tri = jnp.tril(jnp.ones((Tq, Tk), bool))[None, None]
+            mask = tri if mask is None else (mask & tri)
+        if mask is None:
+            mask = jnp.ones((1, 1, 1, 1), bool)
+        return _attention(q, k, v, mask, cfg.num_heads)
+
+    def self_block(p, x, cross_kv=None, *, self_causal=False,
+                   self_kv_mask=None, cross_kv_mask=None):
         a = p["attn"]
-        y = _attention(x @ a["wq"].astype(dt), x @ a["wk"].astype(dt),
-                       x @ a["wv"].astype(dt), mask, cfg.num_heads)
+        y = attend(x, x, a, causal=self_causal, kv_mask=self_kv_mask)
         x = _layer_norm(x + y @ a["wo"].astype(dt),
                         p["ln1"]["s"].astype(dt), p["ln1"]["b"].astype(dt))
         if cross_kv is not None:
             c = p["cross"]
-            y = _attention(x @ c["wq"].astype(dt),
-                           cross_kv @ c["wk"].astype(dt),
-                           cross_kv @ c["wv"].astype(dt), cross_mask,
-                           cfg.num_heads)
+            y = attend(x, cross_kv, c, kv_mask=cross_kv_mask)
             x = _layer_norm(x + y @ c["wo"].astype(dt),
                             p["ln3"]["s"].astype(dt),
                             p["ln3"]["b"].astype(dt))
@@ -156,15 +190,13 @@ def build_model(cfg: NMTConfig) -> Model:
                  * np.sqrt(D) + pos[None, :Tt])
 
         src_valid = (src > 0)
-        enc_mask = src_valid[:, None, None, :]           # [B,1,1,Ts]
         for p in params["enc"]:
-            src_x = self_block(p, src_x, enc_mask)
+            src_x = self_block(p, src_x, self_kv_mask=src_valid)
 
-        causal = jnp.tril(jnp.ones((Tt, Tt), bool))[None, None]
-        cross_mask = src_valid[:, None, None, :]
         for p in params["dec"]:
-            tgt_x = self_block(p, tgt_x, causal, cross_kv=src_x,
-                               cross_mask=cross_mask)
+            tgt_x = self_block(p, tgt_x, cross_kv=src_x,
+                               self_causal=True,
+                               cross_kv_mask=src_valid)
 
         logits = (tgt_x.astype(jnp.float32)
                   @ params["out_proj"]).reshape(B * Tt, V)

@@ -49,3 +49,20 @@ def test_param_specs_indivisible_falls_back(rng):
     w1 = sess.state.params["blocks"][0]["moe_w1"]
     assert w1.sharding.is_fully_replicated  # fallback replicated
     sess.close()
+
+
+def test_moe_lm_pallas_attention(rng):
+    """MoE LM with flash attention: finite training, experts still EP."""
+    cfg = moe_lm.tiny_config(num_partitions=4, learning_rate=1e-3)
+    cfg.use_pallas_attention = True
+    sess, *_ = parallax.parallel_run(
+        moe_lm.build_model(cfg),
+        parallax_config=parallax.Config(run_option="HYBRID",
+                                        search_partitions=False),
+        num_partitions=4)
+    batch = moe_lm.make_batch(rng, 8, 16, cfg.vocab_size)
+    out = sess.run(None, feed_dict=batch)
+    assert np.isfinite(out["loss"])
+    w1 = sess.state.params["blocks"][0]["moe_w1"]
+    assert not w1.sharding.is_fully_replicated
+    sess.close()

@@ -70,3 +70,24 @@ class TestSkipThoughts:
             last = sess.run("loss", feed_dict=batches[i % 2])
         assert last < first * 0.9, (first, last)
         sess.close()
+
+
+def test_nmt_pallas_attention_matches_xla(rng):
+    """All three NMT attention types through the flash kernels track the
+    XLA path."""
+    batches = [nmt.make_batch(rng, 16, 8, 8, 512) for _ in range(3)]
+    for b in batches:
+        b["src"][:, -3:] = 0  # source padding so kv masks matter
+
+    def run(use_pallas):
+        cfg = nmt.tiny_config(num_partitions=8)
+        cfg.use_pallas_attention = use_pallas
+        model = nmt.build_model(cfg)
+        sess, *_ = parallax.parallel_run(
+            model, parallax_config=parallax.Config(run_option="HYBRID",
+                                                   search_partitions=False))
+        losses = [sess.run("loss", feed_dict=b) for b in batches]
+        sess.close()
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-3)
