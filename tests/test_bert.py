@@ -1,6 +1,7 @@
 """BERT pretraining (MLM+NSP) through the hybrid engine."""
 
 import numpy as np
+import pytest
 
 import parallax_tpu as parallax
 from parallax_tpu.models import bert
@@ -30,6 +31,7 @@ def test_classification_and_training(rng):
     sess.close()
 
 
+@pytest.mark.slow
 def test_pallas_attention_matches_xla_path(rng):
     """BERT with the Pallas flash kernel (padding mask included) tracks
     the XLA attention trajectory."""

@@ -90,6 +90,7 @@ def test_routing_per_run_option(rng, run_option, emb_sharded, proj_sharded):
 
 
 @pytest.mark.parametrize("run_option", ["HYBRID", "AR", "SHARD"])
+@pytest.mark.slow
 def test_trajectory_matches_single_device(rng, run_option):
     batches = _batches(rng, 10)
     model = _make_model()

@@ -113,6 +113,7 @@ class TestLM1BModel:
             assert not p.sharding.is_fully_replicated, name
         sess.close()
 
+    @pytest.mark.slow
     def test_training_reduces_loss(self, rng):
         cfg = lm1b.tiny_config(num_partitions=8, learning_rate=0.5)
         model = lm1b.build_model(cfg)
@@ -132,6 +133,7 @@ class TestLM1BModel:
         assert out[1] == 16 * 8  # words metric = sum of weights
         sess.close()
 
+    @pytest.mark.slow
     def test_hybrid_matches_ar_trajectory(self, rng):
         """Sharded sparse path and replicated dense path compute the same
         math (different reduction orders only)."""

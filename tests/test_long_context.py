@@ -9,6 +9,7 @@ import parallax_tpu as parallax
 from parallax_tpu.models import long_context as lc
 
 
+@pytest.mark.slow
 def test_seq_parallel_training_matches_full_attention(rng):
     """Same model, ring attention over the sp axis vs full attention on a
     single logical device: identical loss trajectories."""
@@ -64,6 +65,7 @@ def test_long_sequence_runs(rng):
     sess.close()
 
 
+@pytest.mark.slow
 def test_zigzag_ring_matches_contiguous_trajectory(rng):
     """Balanced zig-zag placement computes the same math as contiguous
     ring attention (engine permutes feeds host-side; positions and

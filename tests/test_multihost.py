@@ -14,6 +14,7 @@ import sys
 import pytest
 
 
+@pytest.mark.slow
 def test_two_process_launch_and_training(tmp_path):
     import socket
     with socket.socket() as s:  # grab a free port; avoids collisions

@@ -68,6 +68,7 @@ def test_bf16(qkv):
                                rtol=0.05, atol=0.05)
 
 
+@pytest.mark.slow
 def test_flash_attention_through_engine(rng):
     """Model flag routes attention through the Pallas kernel inside the
     jitted train step; trajectory matches the XLA path."""

@@ -68,6 +68,7 @@ def test_gradients_match_sequential(rng):
                                    atol=5e-6, err_msg=name)
 
 
+@pytest.mark.slow
 def test_pipeline_lm_through_engine(rng):
     """'pipeline' mode: stages sharded over 'shard', trajectory matches
     pure data parallelism (same math, pipelined schedule)."""

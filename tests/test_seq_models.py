@@ -72,6 +72,7 @@ class TestSkipThoughts:
         sess.close()
 
 
+@pytest.mark.slow
 def test_nmt_pallas_attention_matches_xla(rng):
     """All three NMT attention types through the flash kernels track the
     XLA path."""

@@ -21,6 +21,7 @@ def _run(parallelism, batches, num_partitions):
     return losses, state
 
 
+@pytest.mark.slow
 def test_tp_weights_sharded_and_trajectory_matches_dp(rng):
     batches = [lc.make_batch(rng, 8, 32, 512) for _ in range(4)]
     tp_losses, tp_state = _run("tensor", batches, 4)   # repl=2, tp=4
