@@ -114,6 +114,9 @@ class ParallaxConfig:
       (reference: partitions.py:53-170).
     * ``export_graph_path``: reference dumps the transformed MetaGraph text
       (lib.py:258-264); we dump the compiled step's HLO / StableHLO text.
+    * ``debug_nans``: enable jax_debug_nans for the session — compiled
+      steps re-run op-by-op on a NaN and raise at the producing op (a
+      numerics-sanitizer capability the reference lacks, SURVEY.md §5.2).
     """
 
     run_option: str = consts.RUN_HYBRID
@@ -122,6 +125,7 @@ class ParallaxConfig:
     redirect_path: Optional[str] = None
     search_partitions: bool = True
     export_graph_path: Optional[str] = None
+    debug_nans: bool = False
     communication_config: CommunicationConfig = dataclasses.field(
         default_factory=CommunicationConfig)
     ckpt_config: CheckPointConfig = dataclasses.field(

@@ -247,6 +247,12 @@ class Engine:
                 "sync=False requested: TPU SPMD collectives are inherently "
                 "synchronous; running synchronously (the async-PS staleness "
                 "model does not exist under SPMD).")
+        self._debug_nans_was = None
+        if config.debug_nans:
+            self._debug_nans_was = bool(jax.config.jax_debug_nans)
+            jax.config.update("jax_debug_nans", True)
+            parallax_log.info("debug_nans enabled: steps re-run "
+                              "op-by-op on NaN and raise at the source")
         rng = jax.random.PRNGKey(0)
         params_shapes, mstate_shapes = jax.eval_shape(model.call_init, rng)
         batch_shapes = jax.tree.map(
@@ -327,6 +333,14 @@ class Engine:
         if not self._exported_graph and self.config.export_graph_path:
             self._export_graph(state, batch)
         return new_state, outputs
+
+    def close(self):
+        """Restore process-global settings this engine changed
+        (jax_debug_nans is process-wide; don't leak it into later
+        sessions)."""
+        if self._debug_nans_was is not None:
+            jax.config.update("jax_debug_nans", self._debug_nans_was)
+            self._debug_nans_was = None
 
     def shard_batch(self, batch):
         """Place a host batch onto the mesh, sharded on dim 0 by default

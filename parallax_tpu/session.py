@@ -62,6 +62,8 @@ class ParallaxSession:
         # Host-side mirror of state.step: reading the device value every
         # run() would block on the previous step and kill async dispatch.
         self._host_step = 0
+        from collections import deque
+        self._recent_times = deque(maxlen=20)
 
     # -- lazy build (needs the first batch to know shapes) ----------------
 
@@ -131,6 +133,7 @@ class ParallaxSession:
         dt = time.perf_counter() - t0
         self._profile.after_step(step)
         self._last_outputs = outputs
+        self._recent_times.append(time.perf_counter())
         new_step = step + 1
         self._host_step = new_step
         self._ckpt.maybe_save(new_step, self._state)
@@ -145,6 +148,16 @@ class ParallaxSession:
     @property
     def engine(self):
         return self._engine
+
+    @property
+    def steps_per_sec(self) -> Optional[float]:
+        """Rolling dispatch throughput over the last <=20 steps (the
+        framework-side metric the reference left to user drivers)."""
+        if len(self._recent_times) < 2:
+            return None
+        window = list(self._recent_times)
+        dt = window[-1] - window[0]
+        return (len(window) - 1) / dt if dt > 0 else None
 
     # -- partition search (reference: common/partitions.py) ---------------
 
@@ -215,6 +228,8 @@ class ParallaxSession:
 
     def close(self):
         self._ckpt.close()
+        if self._engine is not None:
+            self._engine.close()
 
 
 def _to_host(v):

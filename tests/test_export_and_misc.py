@@ -34,3 +34,41 @@ def test_unused_knobs_logged_not_fatal(rng):
     loss = sess.run("loss", feed_dict=simple.make_batch(rng, 64))
     assert np.isfinite(loss)
     sess.close()
+
+
+def test_debug_nans_raises_at_source(rng):
+    """Config.debug_nans: a NaN-producing model raises instead of
+    silently training on NaNs (sanitizer capability, SURVEY.md §5.2)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def init_fn(r):
+        return {"w": jnp.ones((4,))}
+
+    def loss_fn(params, batch):
+        return jnp.mean(jnp.log(params["w"] * batch["x"]))  # log(neg)->nan
+
+    model = parallax.Model(init_fn, loss_fn, optimizer=optax.sgd(0.1))
+    cfg = parallax.Config(run_option="AR", search_partitions=False,
+                          debug_nans=True)
+    sess, *_ = parallax.parallel_run(model, parallax_config=cfg)
+    with np.testing.assert_raises(Exception):
+        sess.run("loss",
+                 feed_dict={"x": -np.ones((8, 4), np.float32)})
+    sess.close()
+    # close() restores the process-global flag (no leak into later
+    # sessions)
+    assert not jax.config.jax_debug_nans
+
+
+def test_steps_per_sec_metric(rng):
+    sess, *_ = parallax.parallel_run(
+        simple.build_model(),
+        parallax_config=parallax.Config(run_option="AR",
+                                        search_partitions=False))
+    assert sess.steps_per_sec is None
+    for _ in range(5):
+        sess.run("loss", feed_dict=simple.make_batch(rng, 64))
+    assert sess.steps_per_sec > 0
+    sess.close()
