@@ -123,3 +123,37 @@ class TestPartitionSearchInSession:
         assert sess._search is None, "search did not converge"
         assert len(seen_p) >= 2, f"search never changed p: {seen_p}"
         sess.close()
+
+
+class TestMoreTriggers:
+    def test_profile_range_traces_span(self, tmp_path, rng):
+        prof_dir = str(tmp_path / "prof_range")
+        cfg = parallax.Config(
+            run_option="AR", search_partitions=False,
+            profile_config=parallax.ProfileConfig(profile_dir=prof_dir,
+                                                  profile_range=(2, 4)))
+        sess, *_ = parallax.parallel_run(simple.build_model(0.1),
+                                         parallax_config=cfg)
+        _run_steps(sess, rng, 6)
+        sess.close()
+        traces = glob.glob(os.path.join(prof_dir, "**", "*.xplane.pb"),
+                           recursive=True)
+        assert traces, "profile_range produced no trace"
+
+    def test_save_ckpt_secs_trigger(self, tmp_path, rng):
+        import time
+        ckpt_dir = str(tmp_path / "ckpt_secs")
+        cfg = parallax.Config(
+            run_option="AR", search_partitions=False,
+            ckpt_config=parallax.CheckPointConfig(ckpt_dir=ckpt_dir,
+                                                  save_ckpt_secs=1.0))
+        sess, *_ = parallax.parallel_run(simple.build_model(0.1),
+                                         parallax_config=cfg)
+        _run_steps(sess, rng, 2)
+        time.sleep(1.2)
+        _run_steps(sess, rng, 1)  # secs trigger fires here
+        sess.close()
+        steps = [int(os.path.basename(p)) for p in
+                 glob.glob(os.path.join(ckpt_dir, "*"))
+                 if os.path.basename(p).isdigit()]
+        assert steps, "secs trigger never saved"
