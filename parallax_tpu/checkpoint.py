@@ -54,16 +54,42 @@ class CheckpointHook:
     def enabled(self) -> bool:
         return self._mngr is not None
 
+    # Multi-host secs triggers need a collective decision (below); doing
+    # that every step would block the host on the device stream each step,
+    # so the clock is only consulted on this deterministic step cadence.
+    SECS_BROADCAST_EVERY = 10
+
+    def _decide_due(self, step: int) -> bool:
+        """Save-due decision, deterministic across processes.
+
+        Step triggers are inherently agreed (same step everywhere). Secs
+        triggers read the local wall clock, so hosts can disagree — one
+        would enter the Orbax commit barrier while the rest run ahead
+        into the next step's collectives (distributed hang). Process 0
+        decides and broadcasts the single bit, on a throttled cadence so
+        steady-state steps stay free of host-blocking collectives.
+        """
+        cfg = self._config
+        due_steps = bool(cfg.save_ckpt_steps
+                         and step % cfg.save_ckpt_steps == 0)
+        if not cfg.save_ckpt_secs:
+            return due_steps
+        if jax.process_count() == 1:
+            return due_steps or (time.time() - self._last_save_time
+                                 >= cfg.save_ckpt_secs)
+        if step % self.SECS_BROADCAST_EVERY != 0:
+            return due_steps
+        import numpy as np
+        from jax.experimental import multihost_utils
+        due = due_steps or (time.time() - self._last_save_time
+                            >= cfg.save_ckpt_secs)
+        return bool(multihost_utils.broadcast_one_to_all(
+            np.asarray(due, np.int32)))
+
     def maybe_save(self, step: int, state) -> bool:
         if not self.enabled:
             return False
-        cfg = self._config
-        due_steps = (cfg.save_ckpt_steps
-                     and step % cfg.save_ckpt_steps == 0)
-        due_secs = (cfg.save_ckpt_secs
-                    and time.time() - self._last_save_time
-                    >= cfg.save_ckpt_secs)
-        if not (due_steps or due_secs):
+        if not self._decide_due(step):
             return False
         import orbax.checkpoint as ocp
         self._mngr.save(step, args=ocp.args.StandardSave(state),

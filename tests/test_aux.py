@@ -140,6 +140,45 @@ class TestMoreTriggers:
                            recursive=True)
         assert traces, "profile_range produced no trace"
 
+    def test_secs_trigger_is_broadcast_multiprocess(self, tmp_path,
+                                                    monkeypatch):
+        """Secs-due is decided by process 0 and broadcast: a host whose
+        local clock disagrees must follow the broadcast bit, never its
+        own wall clock (else it hangs the Orbax commit barrier)."""
+        import jax
+        from jax.experimental import multihost_utils
+        from parallax_tpu import checkpoint as ckpt_lib
+
+        hook = ckpt_lib.CheckpointHook(
+            parallax.CheckPointConfig(ckpt_dir=str(tmp_path / "c"),
+                                      save_ckpt_secs=3600.0),
+            worker_id=0)
+        calls = []
+
+        def fake_broadcast(x):
+            calls.append(int(np.asarray(x)))
+            return np.asarray(fake_broadcast.chief_due, np.int32)
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(multihost_utils, "broadcast_one_to_all",
+                            fake_broadcast)
+        k = hook.SECS_BROADCAST_EVERY
+        # off-cadence step: no collective at all, even if local clock due
+        hook._last_save_time -= 7200.0
+        fake_broadcast.chief_due = 1
+        assert hook._decide_due(step=k + 1) is False
+        assert calls == [], "off-cadence step must not enter a collective"
+        # on-cadence, local clock says due but chief says not -> no save
+        fake_broadcast.chief_due = 0
+        assert hook._decide_due(step=k) is False
+        assert calls == [1]
+        # on-cadence, local clock NOT due but chief says due -> must save
+        hook._last_save_time = __import__("time").time()
+        fake_broadcast.chief_due = 1
+        assert hook._decide_due(step=2 * k) is True
+        assert calls == [1, 0]
+        hook.close()
+
     def test_save_ckpt_secs_trigger(self, tmp_path, rng):
         import time
         ckpt_dir = str(tmp_path / "ckpt_secs")
