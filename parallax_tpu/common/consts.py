@@ -9,7 +9,6 @@ host process) plus JAX coordinator details.
 
 # --- run-option dispatch (reference consts.py:18-22) -----------------------
 PARALLAX_RUN_OPTION = "PARALLAX_RUN_OPTION"
-PARALLAX_RUN_MASTER = "PARALLAX_RUN_MASTER"
 # TPU-native mode names; legacy reference names are accepted as aliases.
 RUN_AR = "AR"          # dense all-reduce over ICI   (reference: MPI/Horovod)
 RUN_SHARD = "SHARD"    # row-sharded parameters      (reference: PS)
@@ -17,7 +16,8 @@ RUN_HYBRID = "HYBRID"  # per-variable routing        (reference: HYBRID)
 LEGACY_RUN_ALIASES = {"MPI": RUN_AR, "PS": RUN_SHARD, "HYBRID": RUN_HYBRID}
 
 # --- worker identity (reference consts.py:23-27) ---------------------------
-PARALLAX_WORKER_ID = "PARALLAX_WORKER_ID"
+# Worker id is derived from jax.process_index() at runtime, so unlike the
+# reference there is no PARALLAX_WORKER_ID env var.
 PARALLAX_NUM_WORKERS = "PARALLAX_NUM_WORKERS"
 PARALLAX_MACHINE_ID = "PARALLAX_MACHINE_ID"
 PARALLAX_HOSTNAME = "PARALLAX_HOSTNAME"
@@ -28,22 +28,14 @@ PARALLAX_COORDINATOR_ADDRESS = "PARALLAX_COORDINATOR_ADDRESS"
 PARALLAX_COORDINATOR_PORT_DEFAULT = 8476
 
 # --- partition auto-search (reference consts.py + partitions.py:29-31) -----
-PARALLAX_SEARCH = "PARALLAX_SEARCH"
+# Search state lives in the session (in-place re-jit), so the reference's
+# PARALLAX_SEARCH / PARALLAX_SEARCH_ADDRESS socket channel has no analogue.
 PARALLAX_PARTITIONS = "PARALLAX_PARTITIONS"
 PARALLAX_MIN_PARTITIONS = "PARALLAX_MIN_PARTITIONS"
-PARALLAX_SEARCH_ADDRESS = "PARALLAX_SEARCH_ADDRESS"
 
 # --- timing windows (reference consts.py:37-38, session_context.py:28-29) --
 NUM_ITERATIONS_FOR_WARMUP = 50
 NUM_ITERATIONS_FOR_TEST = 100  # steps [WARMUP, TEST) are timed
 
-# --- staging paths (reference consts.py:33-35) -----------------------------
-REMOTE_STAGING_DIR_FMT = "/tmp/parallax-tpu-{user}"
-
 # --- logging ---------------------------------------------------------------
 PARALLAX_LOG_LEVEL = "PARALLAX_LOG_LEVEL"
-
-# mesh axis names used across the framework
-MESH_AXIS_DATA = "data"    # batch / data-parallel axis (also hosts row shards)
-MESH_AXIS_MODEL = "model"  # tensor-parallel axis (TPU-native extension)
-MESH_AXIS_SEQ = "seq"      # sequence/context-parallel axis (TPU-native ext.)
