@@ -140,9 +140,19 @@ def restore_train_state(ckpt_dir: str, model, seed: int = 0):
         step=jnp.zeros((), jnp.int32), params=params,
         opt_state=model.optimizer.init(params),
         rng=jax.random.PRNGKey(seed), model_state=mstate)
-    abstract = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template)
-    restored = mngr.restore(latest,
-                            args=ocp.args.StandardRestore(abstract))
+    try:
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template)
+        restored = mngr.restore(latest,
+                                args=ocp.args.StandardRestore(abstract))
+    except (ValueError, TypeError):
+        # sync=False checkpoints carry a params-shaped pending_grads
+        # subtree (engine.TrainState); retry with the async template.
+        template = template.replace(
+            pending_grads=jax.tree.map(jnp.zeros_like, params))
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template)
+        restored = mngr.restore(latest,
+                                args=ocp.args.StandardRestore(abstract))
     mngr.close()
     return restored, latest

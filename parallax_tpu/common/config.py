@@ -28,13 +28,20 @@ class PSConfig:
     * ``replicate_variables``: reference mirrors PS variables onto each GPU
       (graph_transform_lib.py:584-704). TPU meaning: when True, *dense*
       variables are replicated over the mesh (the SPMD default); when False
-      they are fully sharded (ZeRO-style) and all-gathered per step.
-    * ``local_aggregation``: combine sparse updates within a host/slice (ICI)
-      before crossing DCN (reference: graph_transform_lib.py:1372-1556).
+      every divisible dense variable stays fully sharded (ZeRO-style) in
+      HYBRID and is all-gathered where consumed (core/engine.py choose()).
+    * ``local_aggregation``: two-stage sparse combine (reference:
+      graph_transform_lib.py:1372-1556) — duplicate row gradients are
+      segment-summed on the producing device before the cross-shard
+      exchange, and the forward ships unique ids/rows only
+      (ops/embedding.py _dedup_capacity). Exact; wire bytes shrink
+      whenever duplicates are guaranteed (table rows < per-device ids).
     * ``boundary_among_servers`` / ``boundary_between_workers_and_servers``:
-      reference op-placement heuristics (graph_transform_lib.py:1315-1370).
-      On TPU the XLA scheduler owns placement; when True we add
-      ``with_sharding_constraint`` hints at the gather/scatter boundary.
+      reference op-placement heuristics that move cheap boundary ops across
+      the worker<->ps cut (graph_transform_lib.py:1315-1370). On TPU, op
+      placement inside the step is owned end-to-end by the XLA scheduler;
+      these knobs are recorded but have no effect (reported by
+      ``unused_knobs()`` when set off-default).
     """
 
     protocol: str = "grpc"
@@ -156,6 +163,12 @@ class ParallaxConfig:
         ps = self.communication_config.ps_config
         if ps.protocol != "grpc":
             unused.append("communication_config.ps_config.protocol")
+        if not ps.boundary_among_servers:
+            unused.append(
+                "communication_config.ps_config.boundary_among_servers")
+        if not ps.boundary_between_workers_and_servers:
+            unused.append("communication_config.ps_config."
+                          "boundary_between_workers_and_servers")
         if self.communication_config.mpi_config.mpirun_options:
             unused.append("communication_config.mpi_config.mpirun_options")
         return unused

@@ -25,8 +25,14 @@ Routing rule (reference: common/runner.py:93-119):
 Sync semantics: SPMD collectives are inherently synchronous, so the
 reference's accumulator/token-queue machinery (add_sync_op,
 graph_transform_lib.py:330-582) has no equivalent here — the all-reduce IS
-the barrier. `sync=False` (async PS) is accepted with a warning and runs
-synchronously; see SURVEY.md §7 hard-part 5.
+the barrier. `sync=False` (reference async PS,
+ps/between_graph_parallel.py:137-146) is emulated as *bounded-staleness
+delayed-gradient* training: the step applies the gradient computed one
+step earlier, `params_{t+1} = params_t - opt(g(params_{t-1}))`, which
+reproduces async PS's defining property (updates computed against stale
+parameters, gradient compute overlapping newer updates) with a
+deterministic staleness bound of 1 instead of the reference's unbounded
+race; see SURVEY.md §7 hard-part 5.
 """
 
 from __future__ import annotations
@@ -134,6 +140,9 @@ class TrainState:
     opt_state: Any
     rng: jax.Array
     model_state: Any = None  # non-trainable state (e.g. BatchNorm stats)
+    # sync=False only: the previous step's gradients, applied this step
+    # (bounded-staleness emulation of the reference's async PS)
+    pending_grads: Any = None
 
 
 @dataclasses.dataclass
@@ -169,6 +178,9 @@ def build_plan(model: Model, mesh: Mesh, config: ParallaxConfig,
     flat, treedef = jax.tree_util.tree_flatten_with_path(params_shapes)
     paths = [classify._pathname(kp) for kp, _ in flat]
 
+    replicate_dense = \
+        config.communication_config.ps_config.replicate_variables
+
     def choose(path, leaf) -> P:
         shape = tuple(leaf.shape)
         vs = var_specs[path]
@@ -187,6 +199,12 @@ def build_plan(model: Model, mesh: Mesh, config: ParallaxConfig,
                 "shard axis %d; replicating (pad with "
                 "ops.embedding.pad_vocab to shard it)", path,
                 shape[:1], p)
+        if not vs.is_sparse and not replicate_dense and shardable:
+            # PSConfig.replicate_variables=False: dense variables stay
+            # fully sharded (ZeRO-style) instead of mirrored — the SPMD
+            # analogue of the reference running PS variables without
+            # per-GPU mirror copies (graph_transform_lib.py:584-704).
+            return mesh_lib.row_sharded_spec(len(shape))
         return mesh_lib.replicated_spec()
 
     import fnmatch
@@ -243,10 +261,11 @@ class Engine:
         self.mesh = mesh
         self.config = config
         if not config.sync:
-            parallax_log.warning(
-                "sync=False requested: TPU SPMD collectives are inherently "
-                "synchronous; running synchronously (the async-PS staleness "
-                "model does not exist under SPMD).")
+            parallax_log.info(
+                "sync=False: running bounded-staleness delayed-gradient "
+                "training (each step applies the previous step's "
+                "gradients) — the deterministic SPMD emulation of the "
+                "reference's async PS mode.")
         self._debug_nans_was = None
         if config.debug_nans:
             self._debug_nans_was = bool(jax.config.jax_debug_nans)
@@ -273,6 +292,7 @@ class Engine:
         model, mesh, config = self.model, self.mesh, self.config
         param_shardings = self._param_shardings
         avg = config.average_sparse
+        local_agg = config.communication_config.ps_config.local_aggregation
         sharded_shapes = self.plan.sharded_shapes
         self._lookup_records: list = []
         lookup_records = self._lookup_records
@@ -283,10 +303,12 @@ class Engine:
             params = jax.lax.with_sharding_constraint(params,
                                                       param_shardings)
             opt_state = model.optimizer.init(params)
+            pending = (None if config.sync
+                       else jax.tree.map(jnp.zeros_like, params))
             return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                               opt_state=opt_state,
                               rng=jax.random.PRNGKey(seed + 1),
-                              model_state=mstate)
+                              model_state=mstate, pending_grads=pending)
 
         def train_step(state: TrainState, batch):
             step_rng = jax.random.fold_in(state.rng, state.step)
@@ -297,21 +319,30 @@ class Engine:
                 lookup_records.clear()
                 with embedding.sharded_lookup_scope(
                         mesh, sharded_shapes, avg,
-                        records=lookup_records):
+                        records=lookup_records,
+                        local_aggregation=local_agg):
                     loss, metrics, new_mstate = model.call_loss(
                         params, batch, step_rng, state.model_state)
                 return loss, (metrics, new_mstate)
 
             (loss, (metrics, new_mstate)), grads = jax.value_and_grad(
                 loss_wrap, has_aux=True)(state.params)
+            if config.sync:
+                apply_grads, pending = grads, None
+            else:
+                # delayed-gradient: apply last step's grads (computed
+                # against the stale params, like an async PS push that
+                # lands one update late); stash this step's for the next
+                apply_grads, pending = state.pending_grads, grads
             updates, opt_state = model.optimizer.update(
-                grads, state.opt_state, state.params)
+                apply_grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             params = jax.lax.with_sharding_constraint(params,
                                                       param_shardings)
             new_state = state.replace(step=state.step + 1, params=params,
                                       opt_state=opt_state,
-                                      model_state=new_mstate)
+                                      model_state=new_mstate,
+                                      pending_grads=pending)
             outputs = {"loss": loss, "global_step": new_state.step}
             outputs.update(metrics)
             return new_state, outputs
@@ -400,7 +431,9 @@ class Engine:
         Sparse path: one record per sharded lookup event in the latest
         trace (ops/embedding.py) — forward all_gather(ids, int32) +
         psum_scatter(rows), backward all_gather(row grads), O(ids · dim)
-        each. Dense alternative: ring all-reduce of every row-sharded
+        each; with local_aggregation the recorded id count is the
+        post-combine unique capacity, so the two-stage win shows up here
+        directly. Dense alternative: ring all-reduce of every row-sharded
         variable's full gradient (~2 bytes moved per gradient byte),
         counted per *variable* from the plan so same-shaped tables don't
         collapse. Call after the first step has compiled.

@@ -25,6 +25,13 @@ win the reference's PS path has over dense AllReduce, which is the
 :385-390): duplicate row updates across the *global* batch are averaged by
 occurrence count instead of summed, implemented as a custom VJP that
 divides the accumulated row gradient by the global row count.
+
+``local_aggregation=True`` (the scope default) is the reference's
+two-stage sparse combine (graph_transform_lib.py:1372-1556) re-expressed
+for SPMD: each device segment-sums its duplicate ids into unique slots
+(stage 1, on-chip, no wire) and only the unique ids/rows/grads cross the
+shard axis (stage 2). The static slot capacity min(local ids, vocab)
+makes the compression exact — see ``_dedup_capacity``.
 """
 
 from __future__ import annotations
@@ -40,7 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD
+from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD, num_devices
 
 
 @dataclasses.dataclass(frozen=True)
@@ -48,9 +55,15 @@ class _MeshCtx:
     mesh: Mesh
     sharded_shapes: frozenset  # shapes (tuples) of row-sharded tables
     average_duplicates: bool
+    # Two-stage sparse combine (reference local_aggregation,
+    # graph_transform_lib.py:1372-1556): segment-sum duplicate ids on the
+    # owning device BEFORE the cross-shard exchange, so only unique rows
+    # cross the wire. Exactness is kept by a static capacity
+    # U = min(ids, vocab) — never fewer slots than possible uniques.
+    local_aggregation: bool = True
     # trace-time record of sharded lookups: list of (table_shape,
-    # flattened id count), one entry per lookup event in the trace —
-    # feeds the exact bytes-on-wire accounting
+    # effective ids crossing the wire), one entry per lookup event in the
+    # trace — feeds the exact bytes-on-wire accounting
     records: Optional[list] = None
 
 
@@ -61,12 +74,14 @@ _CTX: contextvars.ContextVar[Optional[_MeshCtx]] = contextvars.ContextVar(
 @contextlib.contextmanager
 def sharded_lookup_scope(mesh: Mesh, sharded_shapes,
                          average_duplicates: bool = False,
-                         records: Optional[list] = None):
+                         records: Optional[list] = None,
+                         local_aggregation: bool = True):
     """Engine-installed scope: inside it, ``embedding_lookup`` of a table
     whose shape is registered routes through the sharded collective path."""
     token = _CTX.set(_MeshCtx(mesh, frozenset(tuple(s) for s in
                                               sharded_shapes),
-                              average_duplicates, records))
+                              average_duplicates, local_aggregation,
+                              records))
     try:
         yield
     finally:
@@ -122,19 +137,53 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
                        and tuple(table.shape) in ctx.sharded_shapes)
     if not use_sharded or ctx is None or ctx.mesh.shape[AXIS_SHARD] == 1:
         return jnp.take(table, ids, axis=0)
+    cap = _dedup_capacity(table.shape, ids.shape, ctx.mesh,
+                          ctx.local_aggregation)
     if ctx.records is not None:
-        ctx.records.append((tuple(table.shape), int(np.prod(ids.shape))))
+        n = num_devices(ctx.mesh)
+        n_dev = int(np.prod(ids.shape)) // n
+        n_eff = (cap if cap is not None else n_dev) * n
+        ctx.records.append((tuple(table.shape), n_eff))
     if ctx.average_duplicates:
-        return _sharded_lookup_avg(table, ids, ctx.mesh)
-    return _sharded_lookup(table, ids, ctx.mesh)
+        return _sharded_lookup_avg(table, ids, ctx.mesh, cap)
+    return _sharded_lookup(table, ids, ctx.mesh, cap)
+
+
+def _dedup_capacity(table_shape, ids_shape, mesh,
+                    local_aggregation: bool) -> Optional[int]:
+    """Static per-device unique-id slot count for the two-stage combine,
+    or None when the combine is off or cannot reduce wire bytes.
+
+    Exactness needs capacity >= the number of distinct values a device
+    can hold. All out-of-range ids (padding sentinels like -1; ids >= V)
+    are first collapsed onto the single sentinel V (which no shard owns,
+    so it keeps yielding zero rows / dropped grads exactly like the raw
+    masked path), giving at most vocab+1 distinct values — so the bound
+    min(local ids, vocab+1) is never lossy, and a strict win whenever
+    the table is smaller than the device's id list (duplicates then
+    guaranteed, e.g. Zipf-heavy batches over a modest vocab)."""
+    if not local_aggregation:
+        return None
+    n_dev = int(np.prod(ids_shape)) // num_devices(mesh)
+    cap = min(n_dev, int(table_shape[0]) + 1)
+    return cap if cap < n_dev else None
+
+
+def _collapse_out_of_range(flat, vocab):
+    """Map every id outside [0, vocab) to the sentinel ``vocab`` so the
+    dedup capacity bound holds for arbitrary sentinel values."""
+    return jnp.where((flat >= 0) & (flat < vocab), flat, vocab)
 
 
 # --------------------------------------------------------------------------
 # Sum path: plain shard_map; AD transpose gives the scatter-add backward.
+# With dedup, the forward expands unique rows via take(inv), whose
+# transpose segment-sums duplicate row grads BEFORE the cross-shard
+# exchange — the two-stage combine falls out of AD for free.
 # --------------------------------------------------------------------------
 
 
-def _sharded_lookup(table, ids, mesh):
+def _sharded_lookup(table, ids, mesh, dedup_capacity: Optional[int] = None):
     p = mesh.shape[AXIS_SHARD]
     V, D = table.shape
     assert V % p == 0, (
@@ -145,10 +194,18 @@ def _sharded_lookup(table, ids, mesh):
     def local(table_shard, ids_local):
         # table_shard: [V/p, D]; ids_local: [B/(r·p), ...]
         flat = ids_local.reshape(-1)
+        if dedup_capacity is not None:
+            # stage 1: per-device unique compression (sentinel id V is
+            # owned by no shard, so those slots contribute zero rows)
+            flat, inv = jnp.unique(_collapse_out_of_range(flat, V),
+                                   size=dedup_capacity,
+                                   fill_value=V, return_inverse=True)
         ids_all = jax.lax.all_gather(flat, AXIS_SHARD, tiled=True)
         rows = _masked_local_gather(table_shard, ids_all, rows_per_shard)
         out = jax.lax.psum_scatter(rows, AXIS_SHARD, scatter_dimension=0,
                                    tiled=True)
+        if dedup_capacity is not None:
+            out = jnp.take(out, inv.reshape(-1), axis=0)
         return out.reshape(ids_local.shape + (D,))
 
     return jax.shard_map(
@@ -174,16 +231,17 @@ def _masked_local_gather(table_shard, ids_all, rows_per_shard):
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def _sharded_lookup_avg_impl(table, ids, mesh):
-    return _sharded_lookup(table, ids, mesh)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _sharded_lookup_avg_impl(table, ids, mesh, dedup_capacity):
+    return _sharded_lookup(table, ids, mesh, dedup_capacity)
 
 
-def _avg_fwd(table, ids, mesh):
-    return _sharded_lookup(table, ids, mesh), (table.shape, ids)
+def _avg_fwd(table, ids, mesh, dedup_capacity):
+    return _sharded_lookup(table, ids, mesh, dedup_capacity), (table.shape,
+                                                               ids)
 
 
-def _avg_bwd(mesh, res, g):
+def _avg_bwd(mesh, dedup_capacity, res, g):
     (V, D), ids = res
     p = mesh.shape[AXIS_SHARD]
     rows_per_shard = V // p
@@ -192,8 +250,22 @@ def _avg_bwd(mesh, res, g):
         # g_local: [B/(r·p), ..., D]; ids_local: [B/(r·p), ...]
         g_flat = g_local.reshape(-1, D)
         ids_flat = ids_local.reshape(-1)
-        g_all = jax.lax.all_gather(g_flat, AXIS_SHARD, tiled=True)
-        ids_all = jax.lax.all_gather(ids_flat, AXIS_SHARD, tiled=True)
+        if dedup_capacity is not None:
+            # stage 1: segment-sum duplicate row grads (and occurrence
+            # counts — SPARSE_AVERAGE_BY_COUNTER averages by occurrence,
+            # not by unique id) before anything crosses the wire
+            ids_x, inv = jnp.unique(
+                _collapse_out_of_range(ids_flat, V),
+                size=dedup_capacity, fill_value=V, return_inverse=True)
+            g_x = jnp.zeros((dedup_capacity, D), g_flat.dtype
+                            ).at[inv.reshape(-1)].add(g_flat)
+            cnt_x = jnp.zeros((dedup_capacity,), jnp.float32
+                              ).at[inv.reshape(-1)].add(1.0)
+            cnt_all = jax.lax.all_gather(cnt_x, AXIS_SHARD, tiled=True)
+        else:
+            ids_x, g_x, cnt_all = ids_flat, g_flat, None
+        g_all = jax.lax.all_gather(g_x, AXIS_SHARD, tiled=True)
+        ids_all = jax.lax.all_gather(ids_x, AXIS_SHARD, tiled=True)
         lo = jax.lax.axis_index(AXIS_SHARD) * rows_per_shard
         local_idx = ids_all - lo
         valid = (local_idx >= 0) & (local_idx < rows_per_shard)
@@ -202,7 +274,12 @@ def _avg_bwd(mesh, res, g):
         contrib = contrib.at[safe].add(
             jnp.where(valid[:, None], g_all, jnp.zeros_like(g_all)))
         counts = jnp.zeros((rows_per_shard,), jnp.float32)
-        counts = counts.at[safe].add(valid.astype(jnp.float32))
+        if cnt_all is None:
+            # raw path: one occurrence per position, no count wire cost
+            counts = counts.at[safe].add(valid.astype(jnp.float32))
+        else:
+            counts = counts.at[safe].add(
+                jnp.where(valid, cnt_all, jnp.zeros_like(cnt_all)))
         # Merge replica groups *before* dividing: the counter counts every
         # contribution in the global batch (reference accumulates across all
         # workers, then averages once).
@@ -223,5 +300,5 @@ def _avg_bwd(mesh, res, g):
 _sharded_lookup_avg_impl.defvjp(_avg_fwd, _avg_bwd)
 
 
-def _sharded_lookup_avg(table, ids, mesh):
-    return _sharded_lookup_avg_impl(table, ids, mesh)
+def _sharded_lookup_avg(table, ids, mesh, dedup_capacity=None):
+    return _sharded_lookup_avg_impl(table, ids, mesh, dedup_capacity)

@@ -140,6 +140,27 @@ class TestMoreTriggers:
                            recursive=True)
         assert traces, "profile_range produced no trace"
 
+    def test_restore_async_checkpoint(self, tmp_path, rng):
+        """sync=False checkpoints carry pending_grads; the eval-flow
+        restore must handle both sync and async state shapes."""
+        from parallax_tpu.checkpoint import restore_train_state
+        ckpt_dir = str(tmp_path / "ckpt_async")
+        model = simple.build_model(0.1)
+        cfg = parallax.Config(
+            run_option="AR", search_partitions=False,
+            ckpt_config=parallax.CheckPointConfig(ckpt_dir=ckpt_dir,
+                                                  save_ckpt_steps=2))
+        sess, *_ = parallax.parallel_run(model, None, sync=False,
+                                         parallax_config=cfg)
+        _run_steps(sess, rng, 2)
+        sess.close()
+        restored, step = restore_train_state(ckpt_dir,
+                                             simple.build_model(0.1))
+        assert step == 2
+        assert restored.pending_grads is not None
+        assert np.asarray(restored.params["w"]).shape == \
+            np.asarray(restored.pending_grads["w"]).shape
+
     def test_secs_trigger_is_broadcast_multiprocess(self, tmp_path,
                                                     monkeypatch):
         """Secs-due is decided by process 0 and broadcast: a host whose

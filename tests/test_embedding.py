@@ -114,6 +114,119 @@ def test_pad_vocab():
     assert embedding.pad_vocab(17, 8) == 24
 
 
+class TestLocalAggregationDedup:
+    """Two-stage combine (local_aggregation): unique-id compression is
+    active when vocab < per-device ids, cuts wire bytes, and never
+    changes numerics (reference graph_transform_lib.py:1372-1556)."""
+
+    SV, SD, SB = 8, 4, 128  # vocab 8 << per-device ids 16 on the 8-mesh
+
+    def _zipf_ids(self, rng):
+        raw = np.minimum(rng.zipf(1.5, size=(self.SB,)) - 1, self.SV - 1)
+        return jnp.asarray(raw, dtype=jnp.int32)
+
+    def _scope(self, p, avg, local_agg, records=None):
+        mesh = mesh_lib.build_mesh(num_partitions=p)
+        return embedding.sharded_lookup_scope(
+            mesh, [(self.SV, self.SD)], avg, records=records,
+            local_aggregation=local_agg)
+
+    @pytest.mark.parametrize("avg", [False, True])
+    @pytest.mark.parametrize("local_agg", [False, True])
+    def test_numerics_unchanged(self, rng, avg, local_agg):
+        table = jnp.asarray(
+            rng.standard_normal((self.SV, self.SD)).astype(np.float32))
+        ids = self._zipf_ids(rng)
+        g_rows = jnp.asarray(rng.standard_normal(
+            (self.SB, self.SD)).astype(np.float32))
+
+        def ref_fwd():
+            return jnp.take(table, ids, axis=0)
+
+        def ref_grad():
+            dense = jnp.zeros((self.SV, self.SD)).at[ids].add(g_rows)
+            if not avg:
+                return dense
+            counts = jnp.zeros((self.SV,)).at[ids].add(1.0)
+            return dense / jnp.maximum(counts, 1.0)[:, None]
+
+        with self._scope(4, avg, local_agg):
+            def loss(t):
+                return jnp.sum(embedding.embedding_lookup(t, ids) * g_rows)
+            out = jax.jit(
+                lambda t: embedding.embedding_lookup(t, ids))(table)
+            got = jax.jit(jax.grad(loss))(table)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_fwd()),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref_grad()),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_wire_bytes_shrink_on_zipf_batch(self, rng):
+        table = jnp.asarray(
+            rng.standard_normal((self.SV, self.SD)).astype(np.float32))
+        ids = self._zipf_ids(rng)
+        counts = {}
+        for local_agg in (False, True):
+            records = []
+            with self._scope(4, False, local_agg, records=records):
+                jax.jit(lambda t:
+                        embedding.embedding_lookup(t, ids))(table)
+            (_, n_eff), = records
+            counts[local_agg] = n_eff
+        assert counts[False] == self.SB
+        # capacity min(local ids 16, vocab+1 = 9) = 9 slots x 8 devices
+        assert counts[True] == (self.SV + 1) * 8
+        assert counts[True] < counts[False]
+
+    @pytest.mark.parametrize("avg", [False, True])
+    def test_sentinel_ids_exact_under_dedup(self, rng, avg):
+        """Out-of-range ids (padding sentinels) must keep yielding zero
+        rows / dropped grads even when they push the distinct-value count
+        past the vocab size (the capacity bound collapses them to one
+        sentinel first)."""
+        table = jnp.asarray(
+            rng.standard_normal((self.SV, self.SD)).astype(np.float32))
+        # every vocab id present on each device, PLUS -1 and V sentinels
+        base = np.tile(np.arange(self.SV, dtype=np.int32),
+                       self.SB // self.SV)
+        base[::7] = -1
+        base[3::11] = self.SV
+        ids = jnp.asarray(base)
+        g_rows = jnp.asarray(rng.standard_normal(
+            (self.SB, self.SD)).astype(np.float32))
+
+        results = {}
+        for local_agg in (False, True):
+            with self._scope(4, avg, local_agg):
+                def loss(t):
+                    return jnp.sum(
+                        embedding.embedding_lookup(t, ids) * g_rows)
+                out = jax.jit(
+                    lambda t: embedding.embedding_lookup(t, ids))(table)
+                grad = jax.jit(jax.grad(loss))(table)
+            results[local_agg] = (np.asarray(out), np.asarray(grad))
+        np.testing.assert_allclose(results[True][0], results[False][0],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(results[True][1], results[False][1],
+                                   rtol=1e-4, atol=1e-6)
+        # sentinel positions yield zero rows
+        assert np.all(results[True][0][np.asarray(ids) < 0] == 0.0)
+
+    def test_large_vocab_skips_dedup(self, rng):
+        """vocab >= per-device ids: compression cannot win, raw path."""
+        table = jnp.asarray(
+            rng.standard_normal((V, D)).astype(np.float32))
+        ids = jnp.asarray(rng.integers(0, V, size=(B,)), dtype=jnp.int32)
+        records = []
+        mesh = mesh_lib.build_mesh(num_partitions=4)
+        with embedding.sharded_lookup_scope(mesh, [(V, D)], False,
+                                            records=records,
+                                            local_aggregation=True):
+            jax.jit(lambda t: embedding.embedding_lookup(t, ids))(table)
+        (_, n_eff), = records
+        assert n_eff == B
+
+
 def test_p1_degenerates_to_plain_take(table, ids):
     mesh, scope = _ctx(1)
     with scope:

@@ -111,6 +111,132 @@ def test_trajectory_matches_single_device(rng, run_option):
     sess.close()
 
 
+def test_replicate_variables_false_zero_shards_dense(rng):
+    """PSConfig.replicate_variables=False: divisible dense variables stay
+    fully sharded (ZeRO-style) in HYBRID instead of mirrored (reference
+    mirrors PS vars per GPU, graph_transform_lib.py:584-704); trajectory
+    is unchanged vs the replicated default."""
+    batches = _batches(rng, 5)
+
+    def run_once(replicate):
+        cfg = parallax.Config(run_option="HYBRID", search_partitions=False)
+        cfg.communication_config.ps_config.replicate_variables = replicate
+        sess, *_ = parallax.parallel_run(_make_model(),
+                                         parallax_config=cfg)
+        losses = [sess.run("loss", feed_dict=b) for b in batches]
+        proj = sess.state.params["proj"]["w"]
+        emb = sess.state.params["emb"]
+        shard_shape = proj.sharding.shard_shape(proj.shape)
+        params = jax.tree.map(np.asarray, sess.state.params)
+        sess.close()
+        return losses, shard_shape, emb, params
+
+    losses_repl, shape_repl, _, params_repl = run_once(True)
+    losses_zero, shape_zero, emb_zero, params_zero = run_once(False)
+    assert shape_repl == (D, H), "default keeps dense replicated"
+    assert shape_zero == (D // 8, H), "ZeRO shards dense over the mesh"
+    assert not emb_zero.sharding.is_fully_replicated, \
+        "sparse routing unaffected"
+    np.testing.assert_allclose(losses_zero, losses_repl, rtol=1e-4)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        a, b, rtol=1e-4, atol=1e-6), params_zero, params_repl)
+
+
+def test_local_aggregation_wire_bytes_and_parity(rng):
+    """local_aggregation: two-stage combine cuts accounted wire bytes on
+    a duplicate-heavy batch over a small vocab, numerics unchanged."""
+    small_v = 8
+    ids = (rng.integers(0, small_v, size=(B * 8,))).astype(np.int32)
+    batch = {"ids": ids, "y": rng.standard_normal(
+        (B * 8, H)).astype(np.float32)}
+
+    def init_fn(rng_):
+        r1, r2 = jax.random.split(rng_)
+        return {"emb": jax.random.normal(r1, (small_v, D)) * 0.1,
+                "proj": {"w": jax.random.normal(r2, (D, H)) * 0.1}}
+
+    def loss_fn(params, b):
+        rows = emb_ops.embedding_lookup(params["emb"], b["ids"])
+        return jnp.mean((rows @ params["proj"]["w"] - b["y"]) ** 2)
+
+    def run_once(local_agg):
+        model = parallax.Model(init_fn, loss_fn,
+                               optimizer=optax.sgd(0.1),
+                               sparse_params=("emb",))
+        cfg = parallax.Config(run_option="HYBRID",
+                              search_partitions=False)
+        cfg.communication_config.ps_config.local_aggregation = local_agg
+        sess, *_ = parallax.parallel_run(model, parallax_config=cfg)
+        loss = sess.run("loss", feed_dict=batch)
+        bytes_ = sess.engine.sparse_wire_bytes_per_step()
+        emb = np.asarray(sess.state.params["emb"])
+        sess.close()
+        return loss, bytes_, emb
+
+    loss_raw, bytes_raw, emb_raw = run_once(False)
+    loss_agg, bytes_agg, emb_agg = run_once(True)
+    assert bytes_agg["sparse_path_bytes"] < bytes_raw["sparse_path_bytes"]
+    np.testing.assert_allclose(loss_agg, loss_raw, rtol=1e-5)
+    np.testing.assert_allclose(emb_agg, emb_raw, rtol=1e-4, atol=1e-6)
+
+
+def test_sync_false_is_delayed_gradient(rng):
+    """sync=False (reference async PS) = bounded-staleness delayed
+    gradients: params_{t+1} = params_t - lr * g(params_{t-1}); the first
+    step applies zero gradients."""
+    lr = 0.1
+    batches = _batches(rng, 6)
+    model = _make_model(lr)
+
+    # manual delayed-SGD reference on a single device
+    params = model.init_fn(jax.random.PRNGKey(0))
+    init_params = jax.tree.map(np.asarray, params)
+    pending = jax.tree.map(jnp.zeros_like, params)
+    ref_losses = []
+    for b in batches:
+        def lf(p):
+            return model.call_loss(p, {k: jnp.asarray(v)
+                                       for k, v in b.items()}, None)[0]
+        loss, grads = jax.value_and_grad(lf)(params)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, pending)
+        pending = grads
+        ref_losses.append(float(loss))
+
+    sess, *_ = parallax.parallel_run(
+        _make_model(lr), None, sync=False,
+        parallax_config=parallax.Config(run_option="HYBRID",
+                                        search_partitions=False))
+    losses = []
+    for i, b in enumerate(batches):
+        losses.append(sess.run("loss", feed_dict=b))
+        if i == 0:
+            # zero first update: params still at init after step 1
+            jax.tree.map(
+                lambda a, b_: np.testing.assert_allclose(
+                    np.asarray(a), b_, rtol=1e-6),
+                sess.state.params, init_params)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    jax.tree.map(lambda a, b_: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-6),
+        sess.state.params, params)
+    sess.close()
+
+
+def test_boundary_knobs_reported_unused():
+    cfg = parallax.Config(run_option="HYBRID")
+    cfg.communication_config.ps_config.boundary_among_servers = False
+    cfg.communication_config.ps_config \
+        .boundary_between_workers_and_servers = False
+    unused = cfg.unused_knobs()
+    assert ("communication_config.ps_config.boundary_among_servers"
+            in unused)
+    assert ("communication_config.ps_config."
+            "boundary_between_workers_and_servers" in unused)
+    # wired knobs must NOT be reported as unused
+    assert not any("replicate_variables" in u or "local_aggregation" in u
+                   for u in unused)
+
+
 def test_average_sparse_changes_duplicate_updates(rng):
     """average_sparse=True divides duplicate-row updates by their count
     (reference SPARSE_AVERAGE_BY_COUNTER)."""
