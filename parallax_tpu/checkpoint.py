@@ -119,39 +119,68 @@ class CheckpointHook:
             self._mngr.close()
 
 
-def restore_train_state(ckpt_dir: str, model, seed: int = 0):
+def restore_train_state(ckpt_dir: str, model, seed: int = 0,
+                        mesh=None, example_batch=None, config=None):
     """Restore the latest checkpoint into a fresh TrainState template for
-    ``model`` (eval flows: lm1b_eval, cnn_eval). Returns (state, step)."""
+    ``model`` (eval flows: lm1b_eval, cnn_eval). Returns (state, step).
+
+    Every template leaf carries an explicit sharding, so Orbax never
+    falls back to its restore-as-saved heuristic (unsafe across
+    topologies). With ``example_batch`` the engine's sharding plan is
+    rebuilt and the state is restored onto the live training layout
+    (row-sharded tables etc.); otherwise leaves restore replicated over
+    ``mesh`` (default: all local devices) — right for single-host eval.
+    """
     import os
 
     import jax
     import jax.numpy as jnp
     import orbax.checkpoint as ocp
+    from jax.sharding import NamedSharding, PartitionSpec
 
-    from parallax_tpu.core.engine import TrainState
+    from parallax_tpu.common.config import ParallaxConfig
+    from parallax_tpu.core import mesh as mesh_lib
+    from parallax_tpu.core.engine import Engine, TrainState
 
     mngr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
     latest = mngr.latest_step()
     if latest is None:
         mngr.close()
         raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
-    params, mstate = model.call_init(jax.random.PRNGKey(seed))
-    template = TrainState(
-        step=jnp.zeros((), jnp.int32), params=params,
-        opt_state=model.optimizer.init(params),
-        rng=jax.random.PRNGKey(seed), model_state=mstate)
+
+    if example_batch is not None:
+        cfg = config or ParallaxConfig(search_partitions=False)
+        engine = Engine(model, mesh or mesh_lib.build_mesh(), cfg,
+                        example_batch)
+        template = engine.init_state(seed)
+
+        def as_abstract(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=x.sharding)
+    else:
+        mesh = mesh or mesh_lib.build_mesh()
+        replicated = NamedSharding(mesh, PartitionSpec())
+        params, mstate = model.call_init(jax.random.PRNGKey(seed))
+        template = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            opt_state=model.optimizer.init(params),
+            rng=jax.random.PRNGKey(seed), model_state=mstate)
+
+        def as_abstract(x):
+            x = jnp.asarray(x)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=replicated)
+
     try:
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template)
+        abstract = jax.tree.map(as_abstract, template)
         restored = mngr.restore(latest,
                                 args=ocp.args.StandardRestore(abstract))
     except (ValueError, TypeError):
         # sync=False checkpoints carry a params-shaped pending_grads
         # subtree (engine.TrainState); retry with the async template.
-        template = template.replace(
-            pending_grads=jax.tree.map(jnp.zeros_like, params))
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template)
+        template = template.replace(pending_grads=jax.tree.map(
+            lambda x: jnp.zeros_like(jnp.asarray(x)), template.params))
+        abstract = jax.tree.map(as_abstract, template)
         restored = mngr.restore(latest,
                                 args=ocp.args.StandardRestore(abstract))
     mngr.close()

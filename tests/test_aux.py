@@ -140,6 +140,44 @@ class TestMoreTriggers:
                            recursive=True)
         assert traces, "profile_range produced no trace"
 
+    def test_restore_with_explicit_shardings(self, tmp_path, rng, caplog):
+        """Eval restore carries explicit shardings on every template
+        leaf: with an example_batch the live plan's layout (row-sharded
+        tables), otherwise replicated — never Orbax's restore-as-saved
+        fallback (which warns it is unsafe across topologies)."""
+        import logging
+        from parallax_tpu.checkpoint import restore_train_state
+        from parallax_tpu.models import lm1b
+        ckpt_dir = str(tmp_path / "ckpt_sharded")
+        cfg_m = lm1b.tiny_config(num_partitions=8)
+        model = lm1b.build_model(cfg_m)
+        sess, *_ = parallax.parallel_run(
+            model, parallax_config=parallax.Config(
+                run_option="HYBRID", search_partitions=False,
+                ckpt_config=parallax.CheckPointConfig(
+                    ckpt_dir=ckpt_dir, save_ckpt_steps=2)))
+        batch = lm1b.make_batch(rng, 16, 8, cfg_m.vocab_size)
+        sess.run("loss", feed_dict=batch)
+        sess.run("loss", feed_dict=batch)
+        sess.close()
+
+        with caplog.at_level(logging.WARNING):
+            # plan-derived layout: table comes back row-sharded
+            restored, step = restore_train_state(
+                ckpt_dir, lm1b.build_model(cfg_m), example_batch=batch)
+            assert step == 2
+            emb = restored.params["emb"]
+            assert not emb.sharding.is_fully_replicated
+            assert emb.sharding.shard_shape(emb.shape)[0] == \
+                emb.shape[0] // 8
+            # default: explicit replicated layout
+            restored2, _ = restore_train_state(ckpt_dir,
+                                               lm1b.build_model(cfg_m))
+            assert restored2.params["emb"].sharding.is_fully_replicated
+        assert "sharding" not in " ".join(
+            r.message for r in caplog.records
+            if r.levelno >= logging.WARNING).lower()
+
     def test_restore_async_checkpoint(self, tmp_path, rng):
         """sync=False checkpoints carry pending_grads; the eval-flow
         restore must handle both sync and async state shapes."""
