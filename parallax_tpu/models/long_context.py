@@ -148,12 +148,20 @@ def build_model(cfg: LongContextConfig) -> Model:
         mesh = emb_ops.current_mesh()
         zig = _zigzag_active(mesh)
         if zig:
-            # ids arrive zig-zag permuted (engine feed transform): slot j
-            # holds real position perm[j]; positions and next-token
-            # labels follow the static permutation arrays.
+            # Zig-zag placement happens IN-GRAPH: the user (every host)
+            # feeds natural-order ids and this static gather moves each
+            # token to its balanced slot — only int32 ids cross the wire
+            # (4 B/token), and the same code is exact on any topology
+            # (multi-host feeds stay plain process-local slices). After
+            # the permute, slot j holds real position perm[j]; positions
+            # and next-token labels follow the static arrays.
             n = mesh.shape[AXIS_SHARD]
             perm = zigzag_permutation(T, n)
             inv = inverse_zigzag_permutation(T, n)
+            ids = jax.lax.with_sharding_constraint(
+                ids[:, perm],
+                jax.sharding.NamedSharding(mesh,
+                                           P(AXIS_REPL, AXIS_SHARD)))
             pos_rows = perm
             label_map = inv[(perm + 1) % T]
             w_np = (perm != T - 1).astype(np.float32)
@@ -251,28 +259,13 @@ def build_model(cfg: LongContextConfig) -> Model:
             })
     if cfg.parallelism == "ring":
         # dp over 'repl', sp over 'shard': [batch, seq] inputs
-        model = Model(init_fn, loss_fn, optimizer=tx,
-                      dense_params=("emb", "pos"),  # replicated: lookups follow
-                                              # seq-sharded ids, not rows
-                      batch_specs={"ids": P(AXIS_REPL, AXIS_SHARD)})
-        if cfg.zigzag:
-            def to_zigzag(x, mesh):
-                n = mesh.shape[AXIS_SHARD]
-                if n <= 1:
-                    return x
-                if jax.process_count() > 1:
-                    # each host sees only its local slice; permuting it
-                    # locally would disagree with the global perm the
-                    # loss uses (multi-host zigzag needs a global-aware
-                    # feed transform — ROADMAP). Checked here and not at
-                    # build_model time because the model is typically
-                    # built before jax.distributed initializes, when
-                    # process_count still reads 1.
-                    raise NotImplementedError(
-                        "zigzag placement is single-host for now")
-                return x[:, zigzag_permutation(x.shape[1], n)]
-            model.feed_transforms["ids"] = to_zigzag
-        return model
+        # zigzag placement (if enabled) is applied in-graph by loss_fn,
+        # so feeds stay natural-order process-local slices on every
+        # topology — no host-side feed transform needed.
+        return Model(init_fn, loss_fn, optimizer=tx,
+                     dense_params=("emb", "pos"),  # replicated: lookups
+                                             # follow seq-sharded ids
+                     batch_specs={"ids": P(AXIS_REPL, AXIS_SHARD)})
     return Model(init_fn, loss_fn, optimizer=tx,
                  dense_params=("emb", "pos"))
 
