@@ -1,0 +1,87 @@
+"""NMT evaluation: restore a checkpoint, decode (greedy or beam), BLEU.
+
+Parity with the reference's NMT inference/eval flow (reference:
+examples/nmt/nmt_test.py:48-79 testInference, examples/nmt/inference.py,
+utils/evaluation_utils.py BLEU).
+"""
+
+import argparse
+
+import numpy as np
+
+from parallax_tpu.checkpoint import restore_train_state
+from parallax_tpu.common.evaluation import corpus_bleu
+from parallax_tpu.models import nmt
+
+
+def restore_params(ckpt_dir: str, cfg: nmt.NMTConfig):
+    restored, latest = restore_train_state(ckpt_dir, nmt.build_model(cfg))
+    return restored.params, latest
+
+
+def decode_and_bleu(params, cfg: nmt.NMTConfig, eval_pairs,
+                    beam_width: int = 0, alpha: float = 1.0,
+                    max_len=None):
+    """``eval_pairs`` iterable of (src [B,Ts] int32, ref_tgt [B,Tt]
+    int32, with PAD=0/BOS=1/EOS=2). Returns (bleu, hypotheses)."""
+    import jax
+    if beam_width and beam_width > 1:
+        decode = jax.jit(lambda p, s: nmt.beam_decode(
+            p, cfg, s, beam_width=beam_width, alpha=alpha,
+            max_len=max_len))
+    else:
+        decode = jax.jit(lambda p, s: nmt.greedy_decode(
+            p, cfg, s, max_len=max_len))
+    refs, hyps = [], []
+    for src, ref in eval_pairs:
+        out = np.asarray(decode(params, np.asarray(src, np.int32)))
+        for r, h in zip(np.asarray(ref), out):
+            refs.append(nmt.ids_to_tokens(r))
+            hyps.append(nmt.ids_to_tokens(h))
+    return corpus_bleu(refs, hyps), hyps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt_dir", required=True)
+    ap.add_argument("--vocab_size", type=int, default=32000)
+    ap.add_argument("--model_dim", type=int, default=512)
+    ap.add_argument("--num_heads", type=int, default=8)
+    ap.add_argument("--mlp_dim", type=int, default=2048)
+    ap.add_argument("--num_layers", type=int, default=6)
+    ap.add_argument("--max_len", type=int, default=128)
+    ap.add_argument("--partitions", type=int, default=None)
+    ap.add_argument("--beam_width", type=int, default=4)
+    ap.add_argument("--length_penalty", type=float, default=1.0)
+    ap.add_argument("--eval_batches", type=int, default=8)
+    ap.add_argument("--batch_size", type=int, default=16)
+    args = ap.parse_args()
+
+    cfg = nmt.NMTConfig(
+        vocab_size=args.vocab_size, model_dim=args.model_dim,
+        num_heads=args.num_heads, mlp_dim=args.mlp_dim,
+        num_layers=args.num_layers, max_len=args.max_len,
+        num_partitions=args.partitions)
+    params, step = restore_params(args.ckpt_dir, cfg)
+    print(f"restored step {step}")
+
+    # synthetic eval set (plug a real tokenized corpus here); the
+    # reference translation is the identity copy task (tgt = src), the
+    # standard smoke target for seq2seq decode paths — a model trained
+    # on copy pairs scores ~100, anything else ~0
+    rng = np.random.default_rng(123)
+    pairs = []
+    for _ in range(args.eval_batches):
+        src = rng.integers(3, cfg.vocab_size,
+                           (args.batch_size, args.max_len // 2)
+                           ).astype(np.int32)
+        eos = np.full((args.batch_size, 1), nmt.EOS_ID, np.int32)
+        pairs.append((src, np.concatenate([src, eos], axis=1)))
+    bleu, _ = decode_and_bleu(params, cfg, pairs,
+                              beam_width=args.beam_width,
+                              alpha=args.length_penalty)
+    print(f"BLEU: {bleu:.2f}")
+
+
+if __name__ == "__main__":
+    main()

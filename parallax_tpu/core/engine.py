@@ -439,9 +439,9 @@ class Engine:
         collapse. Call after the first step has compiled.
         """
         sparse_bytes = 0
-        for tshape, n_ids in self._lookup_records:
+        for tshape, n_ids, n_cnt in self._lookup_records:
             dim = int(np.prod(tshape[1:])) if len(tshape) > 1 else 1
-            sparse_bytes += n_ids * 4 + 2 * n_ids * dim * 4
+            sparse_bytes += n_ids * 4 + 2 * n_ids * dim * 4 + n_cnt * 4
         dense_bytes = 0
         for vs in self.plan.var_specs.values():
             if vs.is_sparse and tuple(vs.shape) in \

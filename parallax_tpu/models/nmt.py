@@ -2,7 +2,10 @@
 
 Capability parity with the reference's NMT example (reference:
 examples/nmt/ — GNMT-style encoder/decoder with attention, embeddings
-partitioned via parallax.get_partitioner, model_helper.py:309-311).
+partitioned via parallax.get_partitioner, model_helper.py:309-311), plus
+the inference side: greedy and beam-search decoding with the GNMT length
+penalty (reference: examples/nmt/inference.py, model.py decode path;
+golden-tested like nmt_test.py:48-79 testInference).
 
 TPU-first re-design (BASELINE.json config 4): a Transformer
 encoder-decoder instead of the GNMT LSTM stack — the same capability
@@ -15,7 +18,10 @@ path) expressed in MXU-shaped matmuls:
     embeddings;
   * post-LN transformer blocks under `jax.checkpoint`-friendly static
     shapes; bf16 compute, f32 params;
-  * label-smoothed cross-entropy over the target vocab.
+  * label-smoothed cross-entropy over the target vocab;
+  * decoding re-runs the full causal decoder per emitted token inside a
+    `lax.fori_loop` over static shapes (no KV cache yet — ROADMAP), so
+    the whole decode is one compiled program.
 """
 
 from __future__ import annotations
@@ -30,6 +36,8 @@ import optax
 
 from parallax_tpu.core.engine import Model
 from parallax_tpu.ops import embedding as emb_ops
+
+PAD_ID, BOS_ID, EOS_ID = 0, 1, 2
 
 
 @dataclasses.dataclass
@@ -88,23 +96,92 @@ def _layer_norm(x, scale, bias):
     return y * scale + bias
 
 
+def _fused_attention(cfg, q, k, v, *, causal=False, kv_mask=None):
+    """Pallas flash attention on [B, T, D] projections split into heads;
+    covers all three NMT attention patterns."""
+    from parallax_tpu.ops.pallas_attention import flash_attention
+    D = cfg.model_dim
+    B, Tq, _ = q.shape
+    Tk = k.shape[1]
+    h = cfg.num_heads
+    hd = D // h
+    out = flash_attention(q.reshape(B, Tq, h, hd),
+                          k.reshape(B, Tk, h, hd),
+                          v.reshape(B, Tk, h, hd),
+                          causal=causal, kv_mask=kv_mask)
+    return out.reshape(B, Tq, D)
+
+
+def _attend(cfg, dt, x_q, x_kv, w, *, causal=False, kv_mask=None):
+    """One attention with a single (causal, kv_mask) description; the
+    XLA branch derives its dense mask from it."""
+    q = x_q @ w["wq"].astype(dt)
+    k = x_kv @ w["wk"].astype(dt)
+    v = x_kv @ w["wv"].astype(dt)
+    if cfg.use_pallas_attention:
+        return _fused_attention(cfg, q, k, v, causal=causal,
+                                kv_mask=kv_mask)
+    Tq, Tk = q.shape[1], k.shape[1]
+    mask = None
+    if kv_mask is not None:
+        mask = kv_mask[:, None, None, :]
+    if causal:
+        tri = jnp.tril(jnp.ones((Tq, Tk), bool))[None, None]
+        mask = tri if mask is None else (mask & tri)
+    if mask is None:
+        mask = jnp.ones((1, 1, 1, 1), bool)
+    return _attention(q, k, v, mask, cfg.num_heads)
+
+
+def _self_block(cfg, dt, p, x, cross_kv=None, *, self_causal=False,
+                self_kv_mask=None, cross_kv_mask=None):
+    a = p["attn"]
+    y = _attend(cfg, dt, x, x, a, causal=self_causal,
+                kv_mask=self_kv_mask)
+    x = _layer_norm(x + y @ a["wo"].astype(dt),
+                    p["ln1"]["s"].astype(dt), p["ln1"]["b"].astype(dt))
+    if cross_kv is not None:
+        c = p["cross"]
+        y = _attend(cfg, dt, x, cross_kv, c, kv_mask=cross_kv_mask)
+        x = _layer_norm(x + y @ c["wo"].astype(dt),
+                        p["ln3"]["s"].astype(dt),
+                        p["ln3"]["b"].astype(dt))
+    m = p["mlp"]
+    y = jax.nn.relu(x @ m["w1"].astype(dt)) @ m["w2"].astype(dt)
+    return _layer_norm(x + y, p["ln2"]["s"].astype(dt),
+                       p["ln2"]["b"].astype(dt))
+
+
+def _encode(cfg, params, src):
+    """Run the encoder stack; returns (enc_out [B,Ts,D] bf16, src_valid)."""
+    dt = cfg.compute_dtype
+    Ts = src.shape[1]
+    pos = params["pos"].astype(dt)
+    x = (emb_ops.embedding_lookup(params["emb"], src).astype(dt)
+         * np.sqrt(cfg.model_dim) + pos[None, :Ts])
+    src_valid = (src > PAD_ID)
+    for p in params["enc"]:
+        x = _self_block(cfg, dt, p, x, self_kv_mask=src_valid)
+    return x, src_valid
+
+
+def _decode_logits(cfg, params, tgt_in, enc_out, src_valid):
+    """Run the causal decoder stack; returns f32 logits [B, Tt, V] with
+    phantom padded-vocab classes masked to -inf."""
+    dt = cfg.compute_dtype
+    Tt = tgt_in.shape[1]
+    pos = params["pos"].astype(dt)
+    x = (emb_ops.embedding_lookup(params["emb"], tgt_in).astype(dt)
+         * np.sqrt(cfg.model_dim) + pos[None, :Tt])
+    for p in params["dec"]:
+        x = _self_block(cfg, dt, p, x, cross_kv=enc_out,
+                        self_causal=True, cross_kv_mask=src_valid)
+    logits = x.astype(jnp.float32) @ params["out_proj"]
+    return emb_ops.mask_padded_logits(logits, cfg.vocab_size)
+
+
 def build_model(cfg: NMTConfig) -> Model:
     V, D = cfg.padded_vocab, cfg.model_dim
-    dt = cfg.compute_dtype
-
-    def fused_attention(q, k, v, *, causal=False, kv_mask=None):
-        """Pallas flash attention on [B, T, D] projections split into
-        heads; covers all three NMT attention patterns."""
-        from parallax_tpu.ops.pallas_attention import flash_attention
-        B, Tq, _ = q.shape
-        Tk = k.shape[1]
-        h = cfg.num_heads
-        hd = D // h
-        out = flash_attention(q.reshape(B, Tq, h, hd),
-                              k.reshape(B, Tk, h, hd),
-                              v.reshape(B, Tk, h, hd),
-                              causal=causal, kv_mask=kv_mask)
-        return out.reshape(B, Tq, D)
 
     def dense_init(rng, shape):
         return jax.random.normal(rng, shape) * (1.0 / np.sqrt(shape[0]))
@@ -138,69 +215,17 @@ def build_model(cfg: NMTConfig) -> Model:
             "out_proj": dense_init(ks[-1], (D, V)),
         }
 
-    def attend(x_q, x_kv, w, *, causal=False, kv_mask=None):
-        """One attention with a single (causal, kv_mask) description;
-        the XLA branch derives its dense mask from it."""
-        q = x_q @ w["wq"].astype(dt)
-        k = x_kv @ w["wk"].astype(dt)
-        v = x_kv @ w["wv"].astype(dt)
-        if cfg.use_pallas_attention:
-            return fused_attention(q, k, v, causal=causal,
-                                   kv_mask=kv_mask)
-        Tq, Tk = q.shape[1], k.shape[1]
-        mask = None
-        if kv_mask is not None:
-            mask = kv_mask[:, None, None, :]
-        if causal:
-            tri = jnp.tril(jnp.ones((Tq, Tk), bool))[None, None]
-            mask = tri if mask is None else (mask & tri)
-        if mask is None:
-            mask = jnp.ones((1, 1, 1, 1), bool)
-        return _attention(q, k, v, mask, cfg.num_heads)
-
-    def self_block(p, x, cross_kv=None, *, self_causal=False,
-                   self_kv_mask=None, cross_kv_mask=None):
-        a = p["attn"]
-        y = attend(x, x, a, causal=self_causal, kv_mask=self_kv_mask)
-        x = _layer_norm(x + y @ a["wo"].astype(dt),
-                        p["ln1"]["s"].astype(dt), p["ln1"]["b"].astype(dt))
-        if cross_kv is not None:
-            c = p["cross"]
-            y = attend(x, cross_kv, c, kv_mask=cross_kv_mask)
-            x = _layer_norm(x + y @ c["wo"].astype(dt),
-                            p["ln3"]["s"].astype(dt),
-                            p["ln3"]["b"].astype(dt))
-        m = p["mlp"]
-        y = jax.nn.relu(x @ m["w1"].astype(dt)) @ m["w2"].astype(dt)
-        return _layer_norm(x + y, p["ln2"]["s"].astype(dt),
-                           p["ln2"]["b"].astype(dt))
-
     def loss_fn(params, batch, rng):
         src, tgt_in, tgt_out = batch["src"], batch["tgt_in"], batch["tgt_out"]
         w = batch.get("w")
         if w is None:
-            w = (tgt_out > 0).astype(jnp.float32)
-        B, Ts = src.shape
+            w = (tgt_out > PAD_ID).astype(jnp.float32)
+        B, _ = src.shape
         Tt = tgt_in.shape[1]
 
-        pos = params["pos"].astype(dt)
-        src_x = (emb_ops.embedding_lookup(params["emb"], src).astype(dt)
-                 * np.sqrt(D) + pos[None, :Ts])
-        tgt_x = (emb_ops.embedding_lookup(params["emb"], tgt_in).astype(dt)
-                 * np.sqrt(D) + pos[None, :Tt])
-
-        src_valid = (src > 0)
-        for p in params["enc"]:
-            src_x = self_block(p, src_x, self_kv_mask=src_valid)
-
-        for p in params["dec"]:
-            tgt_x = self_block(p, tgt_x, cross_kv=src_x,
-                               self_causal=True,
-                               cross_kv_mask=src_valid)
-
-        logits = (tgt_x.astype(jnp.float32)
-                  @ params["out_proj"]).reshape(B * Tt, V)
-        logits = emb_ops.mask_padded_logits(logits, cfg.vocab_size)
+        enc_out, src_valid = _encode(cfg, params, src)
+        logits = _decode_logits(cfg, params, tgt_in, enc_out,
+                                src_valid).reshape(B * Tt, V)
         labels = tgt_out.reshape(B * Tt)
         wf = w.reshape(B * Tt)
 
@@ -226,10 +251,113 @@ def build_model(cfg: NMTConfig) -> Model:
     return Model(init_fn, loss_fn, optimizer=tx)
 
 
+# --------------------------------------------------------------------------
+# Inference (reference: examples/nmt/inference.py + model.py decode;
+# greedy ≙ beam_width=0, beam ≙ GNMT length-penalised beam search).
+# --------------------------------------------------------------------------
+
+
+def greedy_decode(params, cfg: NMTConfig, src, max_len: Optional[int] = None):
+    """Greedy decode; returns int32 [B, max_len] (PAD after EOS, EOS
+    included). Jittable end-to-end: one fori_loop re-running the causal
+    decoder on the static [B, max_len] buffer each step."""
+    T = int(max_len or cfg.max_len)
+    src = jnp.asarray(src, jnp.int32)
+    B = src.shape[0]
+    enc_out, src_valid = _encode(cfg, params, src)
+    tgt = jnp.full((B, T + 1), PAD_ID, jnp.int32).at[:, 0].set(BOS_ID)
+    done = jnp.zeros((B,), bool)
+
+    def body(t, carry):
+        tgt, done = carry
+        logits = _decode_logits(cfg, params, tgt[:, :-1], enc_out,
+                                src_valid)
+        nxt = jnp.argmax(logits[:, t], axis=-1).astype(jnp.int32)
+        nxt = jnp.where(done, PAD_ID, nxt)
+        tgt = jax.lax.dynamic_update_index_in_dim(tgt, nxt, t + 1, 1)
+        return tgt, done | (nxt == EOS_ID)
+
+    tgt, _ = jax.lax.fori_loop(0, T, body, (tgt, done))
+    return tgt[:, 1:]
+
+
+def _length_penalty(length, alpha):
+    # GNMT length penalty (reference inference: ((5+len)/6)^alpha)
+    return ((5.0 + length) / 6.0) ** alpha
+
+
+def beam_decode(params, cfg: NMTConfig, src, beam_width: int = 4,
+                alpha: float = 1.0, max_len: Optional[int] = None):
+    """Beam search with the GNMT length penalty; returns the best
+    hypothesis per example, int32 [B, max_len]."""
+    T = int(max_len or cfg.max_len)
+    K = int(beam_width)
+    src = jnp.asarray(src, jnp.int32)
+    B = src.shape[0]
+    V = cfg.padded_vocab
+    NEG = -1e9
+
+    # encode once, tile over beams: [B*K, Ts, D]
+    enc_out, src_valid = _encode(cfg, params, src)
+    enc_k = jnp.repeat(enc_out, K, axis=0)
+    valid_k = jnp.repeat(src_valid, K, axis=0)
+
+    tgt = jnp.full((B, K, T + 1), PAD_ID, jnp.int32).at[:, :, 0].set(BOS_ID)
+    # only beam 0 is live at t=0 (all beams identical otherwise)
+    logp = jnp.full((B, K), NEG).at[:, 0].set(0.0)
+    done = jnp.zeros((B, K), bool)
+    lengths = jnp.zeros((B, K), jnp.float32)
+
+    def body(t, carry):
+        tgt, logp, done, lengths = carry
+        logits = _decode_logits(cfg, params,
+                                tgt.reshape(B * K, T + 1)[:, :-1],
+                                enc_k, valid_k)
+        step_logp = jax.nn.log_softmax(logits[:, t]).reshape(B, K, V)
+        # finished beams may only emit PAD, at no cost
+        pad_only = jnp.full((V,), NEG).at[PAD_ID].set(0.0)
+        step_logp = jnp.where(done[:, :, None], pad_only[None, None],
+                              step_logp)
+        cand = logp[:, :, None] + step_logp              # [B, K, V]
+        flat = cand.reshape(B, K * V)
+        top_logp, top_idx = jax.lax.top_k(flat, K)       # [B, K]
+        beam_idx = top_idx // V
+        tok = (top_idx % V).astype(jnp.int32)
+        # reorder carried state by the winning parent beams
+        tgt = jnp.take_along_axis(tgt, beam_idx[:, :, None], axis=1)
+        done = jnp.take_along_axis(done, beam_idx, axis=1)
+        lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+        tgt = jax.lax.dynamic_update_index_in_dim(tgt, tok, t + 1, 2)
+        lengths = jnp.where(done, lengths, lengths + 1.0)
+        done = done | (tok == EOS_ID)
+        return tgt, top_logp, done, lengths
+
+    tgt, logp, done, lengths = jax.lax.fori_loop(
+        0, T, body, (tgt, logp, done, lengths))
+    # length-normalized score; unfinished beams keep raw logp (rarely win)
+    score = logp / _length_penalty(jnp.maximum(lengths, 1.0), alpha)
+    best = jnp.argmax(score, axis=1)
+    return jnp.take_along_axis(
+        tgt, best[:, None, None], axis=1)[:, 0, 1:]
+
+
+def ids_to_tokens(row, id_to_token=None):
+    """Strip BOS/EOS/PAD and map ids to tokens (str(ids) by default) —
+    feed to corpus_bleu (reference: nmt/utils/evaluation_utils.py)."""
+    out = []
+    for i in np.asarray(row).tolist():
+        if i == EOS_ID:
+            break
+        if i in (PAD_ID, BOS_ID):
+            continue
+        out.append(id_to_token[i] if id_to_token else str(i))
+    return out
+
+
 def make_batch(rng: np.random.Generator, batch_size: int, src_len: int,
                tgt_len: int, vocab_size: int):
-    src = rng.integers(1, vocab_size, (batch_size, src_len))
-    tgt = rng.integers(1, vocab_size, (batch_size, tgt_len + 1))
+    src = rng.integers(3, vocab_size, (batch_size, src_len))
+    tgt = rng.integers(3, vocab_size, (batch_size, tgt_len + 1))
     return {"src": src.astype(np.int32),
             "tgt_in": tgt[:, :-1].astype(np.int32),
             "tgt_out": tgt[:, 1:].astype(np.int32)}

@@ -30,8 +30,9 @@ divides the accumulated row gradient by the global row count.
 two-stage sparse combine (graph_transform_lib.py:1372-1556) re-expressed
 for SPMD: each device segment-sums its duplicate ids into unique slots
 (stage 1, on-chip, no wire) and only the unique ids/rows/grads cross the
-shard axis (stage 2). The static slot capacity min(local ids, vocab)
-makes the compression exact — see ``_dedup_capacity``.
+shard axis (stage 2). The static slot capacity min(local ids, vocab+1)
+(the +1 slot absorbs out-of-range sentinels) makes the compression
+exact — see ``_dedup_capacity``.
 """
 
 from __future__ import annotations
@@ -59,11 +60,13 @@ class _MeshCtx:
     # graph_transform_lib.py:1372-1556): segment-sum duplicate ids on the
     # owning device BEFORE the cross-shard exchange, so only unique rows
     # cross the wire. Exactness is kept by a static capacity
-    # U = min(ids, vocab) — never fewer slots than possible uniques.
+    # U = min(ids, vocab+1) — never fewer slots than possible distinct
+    # values (the +1 absorbs out-of-range sentinels).
     local_aggregation: bool = True
     # trace-time record of sharded lookups: list of (table_shape,
-    # effective ids crossing the wire), one entry per lookup event in the
-    # trace — feeds the exact bytes-on-wire accounting
+    # effective ids crossing the wire, count-values crossing the wire),
+    # one entry per lookup event in the trace — feeds the exact
+    # bytes-on-wire accounting
     records: Optional[list] = None
 
 
@@ -143,7 +146,10 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
         n = num_devices(ctx.mesh)
         n_dev = int(np.prod(ids.shape)) // n
         n_eff = (cap if cap is not None else n_dev) * n
-        ctx.records.append((tuple(table.shape), n_eff))
+        # the avg+dedup backward also gathers per-slot occurrence counts
+        n_cnt = n_eff if (ctx.average_duplicates and cap is not None) \
+            else 0
+        ctx.records.append((tuple(table.shape), n_eff, n_cnt))
     if ctx.average_duplicates:
         return _sharded_lookup_avg(table, ids, ctx.mesh, cap)
     return _sharded_lookup(table, ids, ctx.mesh, cap)

@@ -171,7 +171,7 @@ class TestLocalAggregationDedup:
             with self._scope(4, False, local_agg, records=records):
                 jax.jit(lambda t:
                         embedding.embedding_lookup(t, ids))(table)
-            (_, n_eff), = records
+            (_, n_eff, _), = records
             counts[local_agg] = n_eff
         assert counts[False] == self.SB
         # capacity min(local ids 16, vocab+1 = 9) = 9 slots x 8 devices
@@ -223,7 +223,7 @@ class TestLocalAggregationDedup:
                                             records=records,
                                             local_aggregation=True):
             jax.jit(lambda t: embedding.embedding_lookup(t, ids))(table)
-        (_, n_eff), = records
+        (_, n_eff, _), = records
         assert n_eff == B
 
 
