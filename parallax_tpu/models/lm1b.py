@@ -51,6 +51,12 @@ class LM1BConfig:
     learning_rate: float = 0.2
     num_partitions: Optional[int] = None  # None -> pad for device count
     compute_dtype: jnp.dtype = jnp.bfloat16
+    # Scatter-only adagrad over touched table rows (reference
+    # SparseApplyAdagrad, graph_transform_lib.py:71-77). Must bound the
+    # distinct rows a step touches on emb (batch·num_steps ids) and
+    # softmax_w (num_samples + batch·num_steps labels); None = dense
+    # adagrad updates.
+    max_touched_rows: Optional[int] = None
 
     @property
     def padded_vocab(self) -> int:
@@ -153,9 +159,27 @@ def build_model(cfg: LM1BConfig, full_softmax: bool = False) -> Model:
         loss = jnp.sum(losses * wf) / total_w
         return loss, {"words": jnp.sum(wf)}
 
-    tx = optax.chain(
-        optax.clip_by_global_norm(cfg.max_grad_norm),
-        optax.adagrad(cfg.learning_rate, initial_accumulator_value=1.0))
+    if cfg.max_touched_rows:
+        from parallax_tpu.ops.sparse_optim import row_sparse_adagrad
+        # clip sees the full grads (norm unchanged), then tables take
+        # the scatter-only path — trajectory identical to dense adagrad
+        labels = {"emb": "table", "softmax_w": "table",
+                  "softmax_b": "rest", "lstm": "rest", "proj": "rest"}
+        tx = optax.chain(
+            optax.clip_by_global_norm(cfg.max_grad_norm),
+            optax.multi_transform(
+                {"table": row_sparse_adagrad(
+                    cfg.learning_rate, cfg.max_touched_rows,
+                    initial_accumulator_value=1.0),
+                 "rest": optax.adagrad(cfg.learning_rate,
+                                       initial_accumulator_value=1.0)},
+                param_labels=lambda params: {
+                    k: labels.get(k, "rest") for k in params}))
+    else:
+        tx = optax.chain(
+            optax.clip_by_global_norm(cfg.max_grad_norm),
+            optax.adagrad(cfg.learning_rate,
+                          initial_accumulator_value=1.0))
     return Model(init_fn, loss_fn, optimizer=tx)
 
 

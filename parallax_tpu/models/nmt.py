@@ -165,9 +165,8 @@ def _encode(cfg, params, src):
     return x, src_valid
 
 
-def _decode_logits(cfg, params, tgt_in, enc_out, src_valid):
-    """Run the causal decoder stack; returns f32 logits [B, Tt, V] with
-    phantom padded-vocab classes masked to -inf."""
+def _decode_hidden(cfg, params, tgt_in, enc_out, src_valid):
+    """Run the causal decoder stack; returns hidden states [B, Tt, D]."""
     dt = cfg.compute_dtype
     Tt = tgt_in.shape[1]
     pos = params["pos"].astype(dt)
@@ -176,7 +175,24 @@ def _decode_logits(cfg, params, tgt_in, enc_out, src_valid):
     for p in params["dec"]:
         x = _self_block(cfg, dt, p, x, cross_kv=enc_out,
                         self_causal=True, cross_kv_mask=src_valid)
+    return x
+
+
+def _decode_logits(cfg, params, tgt_in, enc_out, src_valid):
+    """Causal decoder + output projection; f32 logits [B, Tt, V] with
+    phantom padded-vocab classes masked to -inf."""
+    x = _decode_hidden(cfg, params, tgt_in, enc_out, src_valid)
     logits = x.astype(jnp.float32) @ params["out_proj"]
+    return emb_ops.mask_padded_logits(logits, cfg.vocab_size)
+
+
+def _decode_step_logits(cfg, params, tgt_in, enc_out, src_valid, t):
+    """Logits for position ``t`` only [B, V]: the full (cache-less)
+    decoder runs over the buffer, but only slot t pays the [D, V]
+    output projection — the loop's dominant matmul."""
+    x = _decode_hidden(cfg, params, tgt_in, enc_out, src_valid)
+    h_t = jax.lax.dynamic_index_in_dim(x, t, axis=1, keepdims=False)
+    logits = h_t.astype(jnp.float32) @ params["out_proj"]
     return emb_ops.mask_padded_logits(logits, cfg.vocab_size)
 
 
@@ -270,9 +286,9 @@ def greedy_decode(params, cfg: NMTConfig, src, max_len: Optional[int] = None):
 
     def body(t, carry):
         tgt, done = carry
-        logits = _decode_logits(cfg, params, tgt[:, :-1], enc_out,
-                                src_valid)
-        nxt = jnp.argmax(logits[:, t], axis=-1).astype(jnp.int32)
+        logits = _decode_step_logits(cfg, params, tgt[:, :-1], enc_out,
+                                     src_valid, t)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         nxt = jnp.where(done, PAD_ID, nxt)
         tgt = jax.lax.dynamic_update_index_in_dim(tgt, nxt, t + 1, 1)
         return tgt, done | (nxt == EOS_ID)
@@ -310,10 +326,10 @@ def beam_decode(params, cfg: NMTConfig, src, beam_width: int = 4,
 
     def body(t, carry):
         tgt, logp, done, lengths = carry
-        logits = _decode_logits(cfg, params,
-                                tgt.reshape(B * K, T + 1)[:, :-1],
-                                enc_k, valid_k)
-        step_logp = jax.nn.log_softmax(logits[:, t]).reshape(B, K, V)
+        logits = _decode_step_logits(cfg, params,
+                                     tgt.reshape(B * K, T + 1)[:, :-1],
+                                     enc_k, valid_k, t)
+        step_logp = jax.nn.log_softmax(logits).reshape(B, K, V)
         # finished beams may only emit PAD, at no cost
         pad_only = jnp.full((V,), NEG).at[PAD_ID].set(0.0)
         step_logp = jnp.where(done[:, :, None], pad_only[None, None],
@@ -334,8 +350,14 @@ def beam_decode(params, cfg: NMTConfig, src, beam_width: int = 4,
 
     tgt, logp, done, lengths = jax.lax.fori_loop(
         0, T, body, (tgt, logp, done, lengths))
-    # length-normalized score; unfinished beams keep raw logp (rarely win)
-    score = logp / _length_penalty(jnp.maximum(lengths, 1.0), alpha)
+    # Only finished hypotheses are length-normalized candidates
+    # (reference inference keeps finished beams); unfinished beams are
+    # pushed below every finished one but keep their relative order, so
+    # the best raw beam still wins when nothing finished.
+    score = jnp.where(done,
+                      logp / _length_penalty(jnp.maximum(lengths, 1.0),
+                                             alpha),
+                      logp + NEG)
     best = jnp.argmax(score, axis=1)
     return jnp.take_along_axis(
         tgt, best[:, None, None], axis=1)[:, 0, 1:]
