@@ -159,12 +159,13 @@ def build_model(cfg: LM1BConfig, full_softmax: bool = False) -> Model:
         loss = jnp.sum(losses * wf) / total_w
         return loss, {"words": jnp.sum(wf)}
 
-    if cfg.max_touched_rows:
+    if cfg.max_touched_rows and not full_softmax:
+        # full_softmax grads touch every softmax_w row, so the touched-
+        # rows bound cannot hold there — dense adagrad in that mode.
         from parallax_tpu.ops.sparse_optim import row_sparse_adagrad
         # clip sees the full grads (norm unchanged), then tables take
         # the scatter-only path — trajectory identical to dense adagrad
-        labels = {"emb": "table", "softmax_w": "table",
-                  "softmax_b": "rest", "lstm": "rest", "proj": "rest"}
+        tables = {"emb": "table", "softmax_w": "table"}
         tx = optax.chain(
             optax.clip_by_global_norm(cfg.max_grad_norm),
             optax.multi_transform(
@@ -174,7 +175,7 @@ def build_model(cfg: LM1BConfig, full_softmax: bool = False) -> Model:
                  "rest": optax.adagrad(cfg.learning_rate,
                                        initial_accumulator_value=1.0)},
                 param_labels=lambda params: {
-                    k: labels.get(k, "rest") for k in params}))
+                    k: tables.get(k, "rest") for k in params}))
     else:
         tx = optax.chain(
             optax.clip_by_global_norm(cfg.max_grad_norm),

@@ -64,6 +64,17 @@ def row_sparse_adagrad(learning_rate: float, max_touched_rows: int,
                 f"shape {g.shape}; use optax.adagrad for non-tables")
         k = min(K, g.shape[0])
         row_act = jnp.sum(jnp.abs(g), axis=1)
+        if k < g.shape[0]:
+            # overflow detection: silent row drops would corrupt
+            # training with no signal, and row_act makes it ~free
+            n_touched = jnp.sum((row_act > 0).astype(jnp.int32))
+            jax.lax.cond(
+                n_touched > k,
+                lambda n: jax.debug.print(
+                    "row_sparse_adagrad: {n} rows touched but "
+                    "max_touched_rows={k}; lowest-activity rows are "
+                    "being DROPPED — raise the bound", n=n, k=k),
+                lambda n: None, n_touched)
         _, idx = jax.lax.top_k(row_act, k)
         g_rows = jnp.take(g, idx, axis=0)
         acc_rows = jnp.take(acc, idx, axis=0) + g_rows * g_rows
