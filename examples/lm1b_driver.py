@@ -41,16 +41,23 @@ def main():
     ap.add_argument("--partitions", type=int, default=None,
                     help="embedding partitions (reference "
                          "get_partitioner(32)); default auto")
+    ap.add_argument("--sparse_grad_mode", default="slices",
+                    choices=["dense", "slices"],
+                    help="'slices' = reference IndexedSlices semantics "
+                         "(tables outside the clip, scatter-only "
+                         "adagrad) and the fast TPU path")
     args = ap.parse_args()
 
     num_partitions = parallax.get_partitioner(args.partitions)
     cfg = lm1b.LM1BConfig(
         vocab_size=args.vocab_size, emb_dim=args.emb_dim,
         hidden_dim=args.hidden_dim, proj_dim=args.proj_dim,
-        num_samples=args.num_samples, num_partitions=num_partitions)
+        num_samples=args.num_samples, num_partitions=num_partitions,
+        sparse_grad_mode=args.sparse_grad_mode)
     model = lm1b.build_model(cfg)
     config = parallax.Config(
         run_option=args.run_option,
+        sparse_grad_mode=args.sparse_grad_mode,
         ckpt_config=parallax.CheckPointConfig(
             ckpt_dir=args.ckpt_dir,
             save_ckpt_steps=args.save_ckpt_steps,

@@ -124,9 +124,20 @@ class ParallaxConfig:
     * ``debug_nans``: enable jax_debug_nans for the session — compiled
       steps re-run op-by-op on a NaN and raise at the producing op (a
       numerics-sanitizer capability the reference lacks, SURVEY.md §5.2).
+    * ``sparse_grad_mode``: how table gradients are represented.
+      'dense' (default): AD scatter-adds row cotangents into a dense
+      [V, D] array (simple, works with any optax optimizer).
+      'slices': for tables registered in ``Model.slice_updaters``, the
+      engine captures (ids, row-grad) pairs at the lookup sites and
+      applies them scatter-only — TF IndexedSlices semantics, exactly
+      how the reference applies sparse grads (outside the global-norm
+      clip, straight into the sparse optimizer kernel; reference
+      examples/lm1b/language_model_graph.py:48-58). No [V, D] cotangent,
+      accumulator pass, or table-grad norm is ever materialized.
     """
 
     run_option: str = consts.RUN_HYBRID
+    sparse_grad_mode: str = "dense"
     average_sparse: bool = False
     sess_config: Any = None
     redirect_path: Optional[str] = None
@@ -147,6 +158,10 @@ class ParallaxConfig:
 
     def __post_init__(self):
         self.run_option = normalize_run_option(self.run_option)
+        if self.sparse_grad_mode not in ("dense", "slices"):
+            raise ValueError(
+                f"sparse_grad_mode must be 'dense' or 'slices', got "
+                f"{self.sparse_grad_mode!r}")
 
     # Reference-style setters (kept so ported driver code works unchanged).
     def set_sync(self, sync: bool) -> None:

@@ -79,13 +79,37 @@ class Model:
                  dense_params: Sequence[str] = (),
                  stateful: bool = False,
                  batch_specs: Optional[Dict[str, Any]] = None,
-                 param_specs: Optional[Dict[str, Any]] = None):
+                 param_specs: Optional[Dict[str, Any]] = None,
+                 slice_updaters: Optional[Dict[str, Any]] = None,
+                 value_and_grad_fn: Optional[Callable] = None):
         self.init_fn = init_fn
         self.loss_fn = loss_fn
+        # Optional fused loss+gradient override:
+        # ``value_and_grad_fn(params, batch, rng) ->
+        # (loss, metrics, grads)``. For models whose backward schedule
+        # is part of the algorithm (1F1B pipelining,
+        # ops/pipeline.pipeline_value_and_grad) and can't be expressed
+        # as jax.value_and_grad(loss_fn). loss_fn must still exist
+        # (classification/eval use it); stateless + sync only.
+        self.value_and_grad_fn = value_and_grad_fn
+        if value_and_grad_fn is not None and stateful:
+            raise ValueError(
+                "value_and_grad_fn is stateless-model only")
+        # (sync-only is enforced by the engine at build time, where the
+        # config is known)
         self.optimizer = optimizer or optax.sgd(0.01)
         self.sparse_params = tuple(sparse_params)
         self.dense_params = tuple(dense_params)
         self.stateful = stateful
+        # path pattern (fnmatch) -> SliceUpdater (ops/sparse_optim.py):
+        # under Config(sparse_grad_mode="slices"), these tables' grads
+        # are captured as (ids, row) slices at their lookup sites and
+        # applied scatter-only, bypassing `optimizer` (which then sees —
+        # and e.g. global-norm-clips — only the remaining params, the
+        # reference's exact grouping, language_model_graph.py:48-58).
+        # A table registered here must be touched ONLY through
+        # embedding_lookup; any other use would silently lose gradient.
+        self.slice_updaters = dict(slice_updaters or {})
         # feed name -> PartitionSpec override (e.g. sequence-parallel
         # inputs sharded P('repl', 'shard') on [batch, seq])
         self.batch_specs = dict(batch_specs or {})
@@ -143,6 +167,9 @@ class TrainState:
     # sync=False only: the previous step's gradients, applied this step
     # (bounded-staleness emulation of the reference's async PS)
     pending_grads: Any = None
+    # sparse_grad_mode="slices" only: {param path: updater state}
+    # (e.g. adagrad row accumulators), updated scatter-only
+    slice_state: Any = None
 
 
 @dataclasses.dataclass
@@ -277,6 +304,9 @@ class Engine:
         batch_shapes = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(np.shape(x), _dtype_of(x)),
             example_batch)
+        self._params_shapes = params_shapes
+        self._mstate_shapes = mstate_shapes
+        self._batch_shapes = batch_shapes
         self.plan = build_plan(model, mesh, config, params_shapes,
                                batch_shapes, mstate_shapes)
         self._param_shardings = jax.tree.map(
@@ -288,6 +318,45 @@ class Engine:
 
     # -- construction ------------------------------------------------------
 
+    def _resolve_slice_updaters(self) -> Dict[str, Any]:
+        """{exact param path: updater} for sparse_grad_mode='slices'."""
+        import fnmatch
+        if (self.config.sparse_grad_mode != "slices"
+                or not self.model.slice_updaters):
+            if self.config.sparse_grad_mode == "slices":
+                parallax_log.warning(
+                    "sparse_grad_mode='slices' but the model declares no "
+                    "slice_updaters; falling back to dense cotangents")
+            return {}
+        resolved = {}
+        hit = set()
+        for path in self.plan.var_specs:
+            for pattern, upd in self.model.slice_updaters.items():
+                if fnmatch.fnmatch(path, pattern):
+                    resolved[path] = upd
+                    hit.add(pattern)
+                    break
+        unmatched = set(self.model.slice_updaters) - hit
+        if unmatched:
+            # a typo'd pattern would silently train the table DENSELY
+            # (clipped, through the optax optimizer) — never degrade
+            # gradient semantics quietly
+            raise ValueError(
+                f"slice_updaters patterns {sorted(unmatched)} match no "
+                f"param path; available: {sorted(self.plan.var_specs)}")
+        return resolved
+
+    def _slice_leaf_map(self, params, resolved):
+        """{id(traced leaf): path} for the registered tables — computed
+        per trace (tracer identity is only meaningful within a trace)."""
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
+        out = {}
+        for kp, leaf in flat:
+            path = classify._pathname(kp)
+            if path in resolved:
+                out[id(leaf)] = path
+        return out
+
     def _build(self):
         model, mesh, config = self.model, self.mesh, self.config
         param_shardings = self._param_shardings
@@ -297,36 +366,161 @@ class Engine:
         self._lookup_records: list = []
         lookup_records = self._lookup_records
 
+        slice_resolved = self._resolve_slice_updaters()
+        if slice_resolved and not config.sync:
+            raise ValueError(
+                "sparse_grad_mode='slices' requires sync=True (the "
+                "delayed-gradient async emulation stashes dense "
+                "grad pytrees)")
+        if slice_resolved and model.value_and_grad_fn is not None:
+            raise ValueError(
+                "sparse_grad_mode='slices' cannot combine with "
+                "Model.value_and_grad_fn (slice capture lives in the "
+                "engine's own loss wrapper)")
+        if model.value_and_grad_fn is not None and not config.sync:
+            raise ValueError(
+                "Model.value_and_grad_fn requires sync=True (the fused "
+                "schedule owns its backward; delayed-gradient emulation "
+                "is untested with it)")
+
+        def discover_slice_events(batch_shapes, mstate_shapes):
+            """Abstract pass recording each registered table's lookup
+            events (delta shapes) for ONE batch-shape signature — no
+            math runs. Called per train_step trace, so a retrace on a
+            new batch shape (e.g. a final partial batch) rediscovers
+            matching delta shapes instead of reusing stale ones."""
+            holder = []
+
+            def _discover(params, batch, rng, mstate):
+                cap = embedding.SliceCapture(
+                    self._slice_leaf_map(params, slice_resolved))
+                holder.append(cap)
+                with embedding.sharded_lookup_scope(
+                        mesh, sharded_shapes, avg,
+                        local_aggregation=local_agg, slice_capture=cap):
+                    loss, _, _ = model.call_loss(params, batch, rng,
+                                                 mstate)
+                return loss
+            jax.eval_shape(_discover, self._params_shapes, batch_shapes,
+                           jax.ShapeDtypeStruct((2,), jnp.uint32),
+                           mstate_shapes)
+            events = holder[0].events
+            missing = set(slice_resolved) - {p for p, _, _ in events}
+            if missing:
+                raise ValueError(
+                    f"slice_updaters registered for {sorted(missing)} "
+                    f"but no embedding_lookup of those tables was "
+                    f"traced; their gradients would be silently lost")
+            parallax_log.info(
+                "sparse_grad_mode=slices: %d lookup events over %s",
+                len(events), sorted(slice_resolved))
+            return events
+
+        self._slice_resolved = slice_resolved
+        if slice_resolved:
+            # validate eagerly on the example batch (raises at build
+            # time, not on the first step)
+            discover_slice_events(self._batch_shapes,
+                                  self._mstate_shapes)
+
+        if slice_resolved:
+            # the model's optimizer sees only non-slice params (so e.g.
+            # its global-norm clip covers exactly the dense group, the
+            # reference's grouping); slice tables are updated
+            # scatter-only below
+            labels = {p: ("slices" if p in slice_resolved else "rest")
+                      for p in self.plan.var_specs}
+
+            def label_fn(params):
+                flat, treedef = jax.tree_util.tree_flatten_with_path(
+                    params)
+                return jax.tree_util.tree_unflatten(
+                    treedef,
+                    [labels[classify._pathname(kp)] for kp, _ in flat])
+            tx = optax.multi_transform(
+                {"slices": optax.set_to_zero(), "rest": model.optimizer},
+                param_labels=label_fn)
+        else:
+            tx = model.optimizer
+
         def init_state(seed: jax.Array) -> TrainState:
             rng = jax.random.PRNGKey(seed)
             params, mstate = model.call_init(rng)
             params = jax.lax.with_sharding_constraint(params,
                                                       param_shardings)
-            opt_state = model.optimizer.init(params)
+            opt_state = tx.init(params)
             pending = (None if config.sync
                        else jax.tree.map(jnp.zeros_like, params))
+            slice_state = None
+            if slice_resolved:
+                # accumulators follow their table's sharding (otherwise
+                # a [V, D] acc would replicate per device on a pod)
+                slice_state = {
+                    path: jax.lax.with_sharding_constraint(
+                        upd.init(_get_path(params, path)),
+                        _get_path(param_shardings, path))
+                    for path, upd in slice_resolved.items()}
             return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                               opt_state=opt_state,
                               rng=jax.random.PRNGKey(seed + 1),
-                              model_state=mstate, pending_grads=pending)
+                              model_state=mstate, pending_grads=pending,
+                              slice_state=slice_state)
 
         def train_step(state: TrainState, batch):
             step_rng = jax.random.fold_in(state.rng, state.step)
 
-            def loss_wrap(params):
+            slice_events = []
+            if slice_resolved:
+                # runs once per trace: shapes are static within it
+                slice_events = discover_slice_events(
+                    jax.tree.map(
+                        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                        batch),
+                    jax.tree.map(
+                        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                        state.model_state))
+            deltas0 = tuple(
+                jnp.zeros(shape, dtype)
+                for _path, shape, dtype in slice_events)
+
+            def loss_wrap(params, deltas):
                 # one trace = one step's lookups; retraces (new batch
                 # shape) replace rather than accumulate
+                lookup_records.clear()
+                cap = None
+                if slice_resolved:
+                    cap = embedding.SliceCapture(
+                        self._slice_leaf_map(params, slice_resolved),
+                        deltas=deltas)
+                with embedding.sharded_lookup_scope(
+                        mesh, sharded_shapes, avg,
+                        records=lookup_records,
+                        local_aggregation=local_agg,
+                        slice_capture=cap):
+                    loss, metrics, new_mstate = model.call_loss(
+                        params, batch, step_rng, state.model_state)
+                ids_list = (tuple(ids for _p, ids in cap.captured)
+                            if cap is not None else ())
+                return loss, (metrics, new_mstate, ids_list)
+
+            if model.value_and_grad_fn is not None:
+                # model-supplied fused loss+grad (e.g. 1F1B pipelining:
+                # the backward schedule is part of the algorithm); the
+                # scope still installs so current_mesh()/sharded lookups
+                # work inside
                 lookup_records.clear()
                 with embedding.sharded_lookup_scope(
                         mesh, sharded_shapes, avg,
                         records=lookup_records,
                         local_aggregation=local_agg):
-                    loss, metrics, new_mstate = model.call_loss(
-                        params, batch, step_rng, state.model_state)
-                return loss, (metrics, new_mstate)
-
-            (loss, (metrics, new_mstate)), grads = jax.value_and_grad(
-                loss_wrap, has_aux=True)(state.params)
+                    loss, metrics, grads = model.value_and_grad_fn(
+                        state.params, batch, step_rng)
+                new_mstate, ids_list, gdeltas = None, (), ()
+            else:
+                (loss, (metrics, new_mstate, ids_list)), \
+                    (grads, gdeltas) = jax.value_and_grad(
+                        loss_wrap, argnums=(0, 1),
+                        has_aux=True)(state.params, deltas0)
             if config.sync:
                 apply_grads, pending = grads, None
             else:
@@ -334,15 +528,39 @@ class Engine:
                 # against the stale params, like an async PS push that
                 # lands one update late); stash this step's for the next
                 apply_grads, pending = state.pending_grads, grads
-            updates, opt_state = model.optimizer.update(
+            updates, opt_state = tx.update(
                 apply_grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
+            slice_state = state.slice_state
+            if slice_resolved:
+                # scatter-only table updates from the captured slices
+                # (ids, d_delta) — the IndexedSlices path; duplicate ids
+                # combine inside the updater
+                per_path: Dict[str, list] = {}
+                for (path, _s, _d), ids, dd in zip(slice_events,
+                                                   ids_list, gdeltas):
+                    per_path.setdefault(path, []).append((ids, dd))
+                slice_state = dict(slice_state)
+                for path, items in per_path.items():
+                    upd = slice_resolved[path]
+                    ids_cat = jnp.concatenate(
+                        [i.reshape(-1) for i, _ in items])
+                    drows_cat = jnp.concatenate(
+                        [d.reshape(-1, d.shape[-1]) for _, d in items])
+                    table = _get_path(params, path)
+                    new_table, new_acc = upd.update(
+                        table, slice_state[path], ids_cat, drows_cat,
+                        average=avg)
+                    params = _set_path(params, path, new_table)
+                    slice_state[path] = jax.lax.with_sharding_constraint(
+                        new_acc, _get_path(param_shardings, path))
             params = jax.lax.with_sharding_constraint(params,
                                                       param_shardings)
             new_state = state.replace(step=state.step + 1, params=params,
                                       opt_state=opt_state,
                                       model_state=new_mstate,
-                                      pending_grads=pending)
+                                      pending_grads=pending,
+                                      slice_state=slice_state)
             outputs = {"loss": loss, "global_step": new_state.step}
             outputs.update(metrics)
             return new_state, outputs
@@ -506,3 +724,37 @@ def _dtype_of(x):
     if d is not None:
         return d
     return np.asarray(x).dtype
+
+
+def _get_path(tree, path: str):
+    """Fetch a leaf by its classify-style 'a/b/0/c' path."""
+    node = tree
+    for part in path.split("/"):
+        if isinstance(node, (list, tuple)):
+            node = node[int(part)]
+        else:
+            node = node[part]
+    return node
+
+
+def _set_path(tree, path: str, value):
+    """Functionally replace a leaf by path (dict/list/tuple pytrees)."""
+    parts = path.split("/")
+
+    def rec(node, i):
+        if i == len(parts):
+            return value
+        p = parts[i]
+        if isinstance(node, dict):
+            new = dict(node)
+            new[p] = rec(node[p], i + 1)
+            return new
+        if isinstance(node, (list, tuple)):
+            idx = int(p)
+            items = list(node)
+            items[idx] = rec(items[idx], i + 1)
+            return tuple(items) if isinstance(node, tuple) else items
+        raise TypeError(
+            f"cannot set path {path!r} inside node of type {type(node)}")
+
+    return rec(tree, 0)

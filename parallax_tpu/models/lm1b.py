@@ -57,6 +57,14 @@ class LM1BConfig:
     # softmax_w (num_samples + batch·num_steps labels); None = dense
     # adagrad updates.
     max_touched_rows: Optional[int] = None
+    # "slices": table grads stay (ids, rows) pairs end-to-end — the
+    # reference's exact gradient processing (IndexedSlices straight into
+    # the sparse Adagrad kernel, with the global-norm clip covering ONLY
+    # the LSTM variables: language_model_graph.py:42-58) and the fast
+    # path on TPU (no dense [V, D] cotangent or table-grad norm).
+    # Requires Config(sparse_grad_mode="slices"). "dense": all grads
+    # dense, clip covers every variable (round-1 behavior).
+    sparse_grad_mode: str = "dense"
 
     @property
     def padded_vocab(self) -> int:
@@ -159,6 +167,21 @@ def build_model(cfg: LM1BConfig, full_softmax: bool = False) -> Model:
         loss = jnp.sum(losses * wf) / total_w
         return loss, {"words": jnp.sum(wf)}
 
+    if cfg.sparse_grad_mode == "slices" and not full_softmax:
+        # Reference-exact grouping (language_model_graph.py:42-58): the
+        # engine masks the slice tables out of `tx`, so the global-norm
+        # clip sees exactly the LSTM group; table slices go straight to
+        # scatter-only adagrad, unclipped.
+        from parallax_tpu.ops.sparse_optim import SliceAdagrad
+        tx = optax.chain(
+            optax.clip_by_global_norm(cfg.max_grad_norm),
+            optax.adagrad(cfg.learning_rate,
+                          initial_accumulator_value=1.0))
+        sl = SliceAdagrad(cfg.learning_rate,
+                          initial_accumulator_value=1.0)
+        return Model(init_fn, loss_fn, optimizer=tx,
+                     slice_updaters={"emb": sl, "softmax_w": sl,
+                                     "softmax_b": sl})
     if cfg.max_touched_rows and not full_softmax:
         # full_softmax grads touch every softmax_w row, so the touched-
         # rows bound cannot hold there — dense adagrad in that mode.

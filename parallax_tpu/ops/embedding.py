@@ -51,6 +51,53 @@ from jax.sharding import Mesh, PartitionSpec as P
 from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD, num_devices
 
 
+class SliceCapture:
+    """Per-trace state for the engine's "slices" sparse-gradient mode.
+
+    The TPU-native IndexedSlices: instead of letting AD scatter row
+    cotangents into a dense [V, D] zero array (materialized in HBM every
+    step), each registered table's lookup runs on ``stop_gradient(table)``
+    and adds a caller-supplied zero ``delta`` of the *rows* shape; the
+    gradient w.r.t. that delta IS the per-occurrence row-gradient slice,
+    and the captured ids name the rows. The engine pairs (ids, d_delta)
+    and applies them with a scatter-only SliceUpdater
+    (ops/sparse_optim.py) — the reference's IndexedSlices →
+    SparseApplyAdagrad pipeline (language_model_graph.py:48-58,
+    graph_transform_lib.py:71-77) with the dense cotangent deleted.
+
+    Used in two passes: discovery (``deltas=None``, under
+    ``jax.eval_shape``) records each lookup event's delta shape; the real
+    trace feeds matching zero deltas and captures the traced ids.
+    """
+
+    def __init__(self, table_paths, deltas=None):
+        # id(traced table leaf) -> param path; valid for one trace only
+        self.table_paths = dict(table_paths)
+        self.deltas = list(deltas) if deltas is not None else None
+        self.events = []   # discovery: (path, rows_shape, rows_dtype)
+        self.captured = []  # real pass: (path, traced ids array)
+        self._next = 0
+
+    def path_of(self, table) -> Optional[str]:
+        return self.table_paths.get(id(table))
+
+    def attach(self, path, ids, rows):
+        """Record this lookup event; in the real pass add its delta."""
+        if self.deltas is None:
+            self.events.append((path, tuple(rows.shape),
+                                jnp.result_type(rows)))
+            return rows
+        self.captured.append((path, ids))
+        delta = self.deltas[self._next]
+        self._next += 1
+        if tuple(delta.shape) != tuple(rows.shape):
+            raise ValueError(
+                f"slices-mode delta {self._next - 1} for {path!r} has "
+                f"shape {delta.shape}, lookup produced {rows.shape}; "
+                f"lookup order must be deterministic across traces")
+        return rows + delta.astype(rows.dtype)
+
+
 @dataclasses.dataclass(frozen=True)
 class _MeshCtx:
     mesh: Mesh
@@ -68,6 +115,8 @@ class _MeshCtx:
     # one entry per lookup event in the trace — feeds the exact
     # bytes-on-wire accounting
     records: Optional[list] = None
+    # "slices" sparse-gradient mode (see SliceCapture)
+    slice_capture: Optional[SliceCapture] = None
 
 
 _CTX: contextvars.ContextVar[Optional[_MeshCtx]] = contextvars.ContextVar(
@@ -78,13 +127,14 @@ _CTX: contextvars.ContextVar[Optional[_MeshCtx]] = contextvars.ContextVar(
 def sharded_lookup_scope(mesh: Mesh, sharded_shapes,
                          average_duplicates: bool = False,
                          records: Optional[list] = None,
-                         local_aggregation: bool = True):
+                         local_aggregation: bool = True,
+                         slice_capture: Optional[SliceCapture] = None):
     """Engine-installed scope: inside it, ``embedding_lookup`` of a table
     whose shape is registered routes through the sharded collective path."""
     token = _CTX.set(_MeshCtx(mesh, frozenset(tuple(s) for s in
                                               sharded_shapes),
                               average_duplicates, local_aggregation,
-                              records))
+                              records, slice_capture))
     try:
         yield
     finally:
@@ -134,12 +184,22 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
     to the reference's MPI mode where every replica holds the full variable.
     """
     ctx = _CTX.get()
+    # slices mode: this table's gradient flows through the injected
+    # delta, not through AD on the table (see SliceCapture)
+    slice_path = None
+    if ctx is not None and ctx.slice_capture is not None:
+        slice_path = ctx.slice_capture.path_of(table)
+        if slice_path is not None:
+            table = jax.lax.stop_gradient(table)
     use_sharded = sharded
     if use_sharded is None:
         use_sharded = (ctx is not None
                        and tuple(table.shape) in ctx.sharded_shapes)
     if not use_sharded or ctx is None or ctx.mesh.shape[AXIS_SHARD] == 1:
-        return jnp.take(table, ids, axis=0)
+        rows = jnp.take(table, ids, axis=0)
+        if slice_path is not None:
+            rows = ctx.slice_capture.attach(slice_path, ids, rows)
+        return rows
     cap = _dedup_capacity(table.shape, ids.shape, ctx.mesh,
                           ctx.local_aggregation)
     if ctx.records is not None:
@@ -151,8 +211,12 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
             else 0
         ctx.records.append((tuple(table.shape), n_eff, n_cnt))
     if ctx.average_duplicates:
-        return _sharded_lookup_avg(table, ids, ctx.mesh, cap)
-    return _sharded_lookup(table, ids, ctx.mesh, cap)
+        rows = _sharded_lookup_avg(table, ids, ctx.mesh, cap)
+    else:
+        rows = _sharded_lookup(table, ids, ctx.mesh, cap)
+    if slice_path is not None:
+        rows = ctx.slice_capture.attach(slice_path, ids, rows)
+    return rows
 
 
 def _dedup_capacity(table_shape, ids_shape, mesh,

@@ -29,6 +29,7 @@ bound from static batch shapes, never guess.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import NamedTuple
 
 import jax
@@ -38,6 +39,14 @@ import optax
 
 class RowSparseAdagradState(NamedTuple):
     sum_of_squares: jax.Array
+    # per-param count of steps that touched more rows than
+    # max_touched_rows (those steps DROP their lowest-activity rows).
+    # In-state counter rather than a host print: device->host callbacks
+    # don't exist on all TPU runtimes, and state survives checkpoints.
+    # NOTE: adding this field changed the opt_state pytree — checkpoints
+    # written by the 1-field revision need their opt_state re-initialized
+    # (or a zeros overflow_steps grafted in) to restore.
+    overflow_steps: jax.Array
 
 
 def row_sparse_adagrad(learning_rate: float, max_touched_rows: int,
@@ -54,10 +63,12 @@ def row_sparse_adagrad(learning_rate: float, max_touched_rows: int,
                          initial_accumulator_value)
 
     def init_fn(params):
-        return RowSparseAdagradState(jax.tree.map(
-            lambda p: jnp.full(p.shape, init, p.dtype), params))
+        return RowSparseAdagradState(
+            jax.tree.map(lambda p: jnp.full(p.shape, init, p.dtype),
+                         params),
+            jax.tree.map(lambda p: jnp.zeros((), jnp.int32), params))
 
-    def _update_one(g, acc, p):
+    def _update_one(g, acc, ovf):
         if g.ndim != 2:
             raise ValueError(
                 f"row_sparse_adagrad expects [rows, dim] params, got "
@@ -68,13 +79,7 @@ def row_sparse_adagrad(learning_rate: float, max_touched_rows: int,
             # overflow detection: silent row drops would corrupt
             # training with no signal, and row_act makes it ~free
             n_touched = jnp.sum((row_act > 0).astype(jnp.int32))
-            jax.lax.cond(
-                n_touched > k,
-                lambda n: jax.debug.print(
-                    "row_sparse_adagrad: {n} rows touched but "
-                    "max_touched_rows={k}; lowest-activity rows are "
-                    "being DROPPED — raise the bound", n=n, k=k),
-                lambda n: None, n_touched)
+            ovf = ovf + (n_touched > k).astype(jnp.int32)
         _, idx = jax.lax.top_k(row_act, k)
         g_rows = jnp.take(g, idx, axis=0)
         acc_rows = jnp.take(acc, idx, axis=0) + g_rows * g_rows
@@ -84,15 +89,128 @@ def row_sparse_adagrad(learning_rate: float, max_touched_rows: int,
         u_rows = (inv * g_rows) * jnp.asarray(-lr, g_rows.dtype)
         new_acc = acc.at[idx].set(acc_rows)
         updates = jnp.zeros_like(g).at[idx].set(u_rows)
-        return updates, new_acc
+        return updates, new_acc, ovf
 
     def update_fn(updates, state, params=None):
         del params
         flat_u, treedef = jax.tree_util.tree_flatten(updates)
         flat_a = treedef.flatten_up_to(state.sum_of_squares)
-        out = [_update_one(g, a, None) for g, a in zip(flat_u, flat_a)]
-        new_updates = treedef.unflatten([u for u, _ in out])
-        new_accs = treedef.unflatten([a for _, a in out])
-        return new_updates, RowSparseAdagradState(new_accs)
+        flat_o = treedef.flatten_up_to(state.overflow_steps)
+        out = [_update_one(g, a, o)
+               for g, a, o in zip(flat_u, flat_a, flat_o)]
+        new_updates = treedef.unflatten([u for u, _, _ in out])
+        new_accs = treedef.unflatten([a for _, a, _ in out])
+        new_ovf = treedef.unflatten([o for _, _, o in out])
+        return new_updates, RowSparseAdagradState(new_accs, new_ovf)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+# ---------------------------------------------------------------------------
+# Slice updaters: the engine's "slices" sparse-gradient mode
+# (ParallaxConfig.sparse_grad_mode="slices") never materializes a dense
+# [V, D] cotangent — the lookup sites capture (ids, d_rows) pairs (the
+# exact analogue of TF's IndexedSlices, which is what the reference's
+# sparse path applies: language_model_graph.py:48-58 feeds IndexedSlices
+# straight into AdagradOptimizer, *outside* the global-norm clip) and a
+# SliceUpdater applies them scatter-only.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceAdagrad:
+    """Adagrad over gradient slices: ``param[r] -= lr * G_r / sqrt(acc_r)``
+    where ``G_r`` is the per-occurrence row gradients summed per row (or
+    averaged by occurrence count with ``average=True`` — the fork's
+    SPARSE_AVERAGE_BY_COUNTER semantics).
+
+    Matches `optax.adagrad` / `row_sparse_adagrad` exactly on rows that
+    were touched; untouched rows are never read or written. The reference
+    analogue is `SparseApplyAdagrad` (graph_transform_lib.py:71-77).
+
+    ``grad_scale`` multiplies the incoming slices before the update —
+    the reference LM1B scales its embedding IndexedSlices by batch_size
+    (language_model_graph.py:48-50); expose the same knob.
+    """
+
+    learning_rate: float
+    initial_accumulator_value: float = 0.1
+    eps: float = 1e-7
+    grad_scale: float = 1.0
+
+    def init(self, param: jax.Array) -> jax.Array:
+        return jnp.full(param.shape, self.initial_accumulator_value,
+                        param.dtype)
+
+    def update(self, param: jax.Array, acc: jax.Array, ids: jax.Array,
+               drows: jax.Array, average: bool = False):
+        """Apply slices (ids [N], drows [N, D]) to (param, acc) [V, D].
+
+        Duplicate ids are combined (sum, or occurrence-mean with
+        ``average``) BEFORE squaring into the accumulator — identical to
+        what the dense scatter-add cotangent would have produced. Ids
+        outside [0, V) are dropped (zero-row parity with the sharded
+        lookup's sentinel handling).
+        """
+        V = param.shape[0]
+        ids = ids.reshape(-1)
+        drows = drows.reshape(ids.shape[0], -1).astype(param.dtype)
+        if self.grad_scale != 1.0:
+            drows = drows * jnp.asarray(self.grad_scale, drows.dtype)
+        # combine duplicates: unique slots (static capacity = N ids; the
+        # sentinel V catches out-of-range) then segment-sum
+        cap = ids.shape[0]
+        uids, inv = jnp.unique(jnp.where((ids >= 0) & (ids < V), ids, V),
+                               size=cap, fill_value=V,
+                               return_inverse=True)
+        gsum = jnp.zeros((cap, drows.shape[1]), drows.dtype
+                         ).at[inv.reshape(-1)].add(drows)
+        if average:
+            cnt = jnp.zeros((cap,), jnp.float32).at[inv.reshape(-1)].add(
+                1.0)
+            gsum = gsum * jnp.where(
+                cnt > 0, 1.0 / jnp.maximum(cnt, 1.0), 0.0
+            )[:, None].astype(gsum.dtype)
+        acc_rows = acc.at[uids, :].get(mode="fill", fill_value=0.0)
+        acc_rows = acc_rows + gsum * gsum
+        inv_rt = jnp.where(acc_rows > 0,
+                           jax.lax.rsqrt(acc_rows + self.eps), 0.0)
+        u_rows = (inv_rt * gsum) * jnp.asarray(-self.learning_rate,
+                                               gsum.dtype)
+        new_acc = acc.at[uids, :].set(acc_rows, mode="drop")
+        new_param = param.at[uids, :].add(u_rows.astype(param.dtype),
+                                          mode="drop")
+        return new_param, new_acc
+
+
+def collect_overflow_steps(opt_state) -> int:
+    """Total row_sparse_adagrad overflow events in an optimizer state.
+
+    Walks any optax state pytree, summing `overflow_steps` from every
+    RowSparseAdagradState found. Surfaces the silent-drop signal the
+    updater records in-state (device->host prints don't exist on all
+    TPU runtimes): a nonzero count means some steps touched more rows
+    than max_touched_rows and DROPPED their lowest-activity rows —
+    raise the bound. `ParallaxSession.sparse_overflow_steps()` calls
+    this on the live state.
+    """
+    total = 0
+
+    def visit(node):
+        nonlocal total
+        if isinstance(node, RowSparseAdagradState):
+            for leaf in jax.tree.leaves(node.overflow_steps):
+                total += int(leaf)
+            return
+        if isinstance(node, (list, tuple)):
+            for c in node:
+                visit(c)
+        elif isinstance(node, dict):
+            for c in node.values():
+                visit(c)
+        elif hasattr(node, "_fields"):  # other NamedTuples (optax states)
+            for c in node:
+                visit(c)
+
+    visit(opt_state)
+    return total

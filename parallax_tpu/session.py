@@ -149,6 +149,16 @@ class ParallaxSession:
     def engine(self):
         return self._engine
 
+    def sparse_overflow_steps(self) -> int:
+        """Total row_sparse_adagrad overflow events so far: steps that
+        touched more rows than max_touched_rows and silently DROPPED
+        their lowest-activity rows. Nonzero => raise the bound.
+        (ops/sparse_optim.collect_overflow_steps on the live state.)"""
+        if self._state is None:
+            return 0
+        from parallax_tpu.ops.sparse_optim import collect_overflow_steps
+        return collect_overflow_steps(self._state.opt_state)
+
     @property
     def steps_per_sec(self) -> Optional[float]:
         """Rolling dispatch throughput over the last <=20 steps (the

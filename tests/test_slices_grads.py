@@ -1,0 +1,214 @@
+"""sparse_grad_mode="slices": IndexedSlices-exact table gradients.
+
+The reference applies sparse grads as IndexedSlices straight into the
+sparse optimizer kernel, OUTSIDE the global-norm clip (the clip covers
+only the LSTM group: examples/lm1b/language_model_graph.py:42-58,
+SparseApplyAdagrad graph_transform_lib.py:71-77). Slices mode reproduces
+that grouping and never materializes a dense [V, D] cotangent.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import parallax_tpu as parallax
+from parallax_tpu.models import lm1b
+from parallax_tpu.ops.sparse_optim import SliceAdagrad
+
+
+def _run_lm1b(mode, steps=4, max_grad_norm=1e9, average_sparse=False,
+              batch_fn=None, keep_prob=1.0):
+    cfg = lm1b.tiny_config(keep_prob=keep_prob,
+                           max_grad_norm=max_grad_norm)
+    cfg.sparse_grad_mode = mode
+    sess, *_ = parallax.parallel_run(
+        lm1b.build_model(cfg),
+        parallax_config=parallax.Config(run_option="HYBRID",
+                                        search_partitions=False,
+                                        sparse_grad_mode=mode,
+                                        average_sparse=average_sparse))
+    r = np.random.default_rng(1)
+    losses = []
+    for i in range(steps):
+        b = (batch_fn(r) if batch_fn
+             else lm1b.make_batch(r, 16, 8, cfg.vocab_size))
+        losses.append(sess.run("loss", feed_dict=b))
+    state = sess.state
+    sess.close()
+    return losses, state
+
+
+def test_matches_dense_mode_when_clip_inactive():
+    """With an inactive clip, slices mode == dense mode exactly (the
+    only semantic difference is the clip grouping)."""
+    dense, _ = _run_lm1b("dense")
+    slices, state = _run_lm1b("slices")
+    np.testing.assert_allclose(dense, slices, rtol=2e-5)
+    # the slice accumulators exist and were touched
+    assert set(state.slice_state) == {"emb", "softmax_w", "softmax_b"}
+    acc = state.slice_state["emb"]
+    # ...and follow the table's row-sharding (a replicated [V, D] acc
+    # would waste a full table copy per device on a pod)
+    assert acc.sharding.shard_shape(acc.shape)[0] == acc.shape[0] // 8
+    acc = np.asarray(acc)
+    assert (acc > 1.0).any(), "no accumulator update recorded"
+
+
+def test_matches_dense_mode_with_averaging():
+    """SPARSE_AVERAGE_BY_COUNTER parity holds in slices mode too (the
+    updater divides row sums by global occurrence counts)."""
+    dense, _ = _run_lm1b("dense", average_sparse=True)
+    slices, _ = _run_lm1b("slices", average_sparse=True)
+    np.testing.assert_allclose(dense, slices, rtol=2e-5)
+
+
+def test_clip_covers_only_dense_group():
+    """With a tight clip the two modes MUST differ: dense mode clips
+    table grads too; slices mode (reference semantics,
+    language_model_graph.py:48-58) leaves tables unclipped."""
+    dense, _ = _run_lm1b("dense", steps=3, max_grad_norm=0.05)
+    slices, _ = _run_lm1b("slices", steps=3, max_grad_norm=0.05)
+    assert not np.allclose(dense[1:], slices[1:], rtol=1e-4), (
+        "slices mode should exclude tables from the global-norm clip")
+
+
+def test_slices_update_matches_reference_semantics():
+    """One slices-mode step == manual IndexedSlices math: dense grads
+    clipped on their own group norm, table rows updated by unclipped
+    scatter adagrad."""
+    cfg = lm1b.tiny_config(keep_prob=1.0, max_grad_norm=0.05)
+    cfg.sparse_grad_mode = "slices"
+    sess, *_ = parallax.parallel_run(
+        lm1b.build_model(cfg),
+        parallax_config=parallax.Config(run_option="HYBRID",
+                                        search_partitions=False,
+                                        sparse_grad_mode="slices"))
+    r = np.random.default_rng(3)
+    b = lm1b.make_batch(r, 16, 8, cfg.vocab_size)
+    sess._ensure_engine(sess._convert_feed(b))  # build without stepping
+    state0 = sess.state
+    # snapshot BEFORE stepping: the step donates state0's buffers
+    p0 = jax.tree.map(np.asarray, state0.params)
+    rng0_key = np.asarray(state0.rng)
+    sess.run("loss", feed_dict=b)
+    p1 = jax.tree.map(np.asarray, sess.state.params)
+    sess.close()
+
+    # manual: dense grads of the same loss at p0
+    model = lm1b.build_model(
+        lm1b.tiny_config(keep_prob=1.0, max_grad_norm=0.05))
+    rng0 = jax.random.fold_in(jnp.asarray(rng0_key), 0)
+    p0j = jax.tree.map(jnp.asarray, p0)
+    grads = jax.grad(
+        lambda p: model.loss_fn(p, b, rng0)[0])(p0j)
+    grads = jax.tree.map(np.asarray, grads)
+    # lstm group: clip by ITS OWN global norm, adagrad(acc0=1)
+    lstm_leaves = jax.tree.leaves(grads["lstm"])
+    gnorm = float(np.sqrt(sum(float((g ** 2).sum())
+                              for g in lstm_leaves)))
+    scale = min(1.0, 0.05 / gnorm)
+    tx = optax.adagrad(cfg.learning_rate, initial_accumulator_value=1.0)
+    lstm0 = p0j["lstm"]
+    st = tx.init(lstm0)
+    up, _ = tx.update(jax.tree.map(lambda g: g * scale, grads["lstm"]),
+                      st, lstm0)
+    lstm_expect = jax.tree.map(np.asarray,
+                               optax.apply_updates(lstm0, up))
+    np.testing.assert_allclose(p1["lstm"]["w"], lstm_expect["w"],
+                               rtol=2e-5, atol=1e-7)
+    # tables: unclipped scatter adagrad on the dense cotangent's rows
+    sl = SliceAdagrad(cfg.learning_rate, initial_accumulator_value=1.0)
+    V = cfg.padded_vocab
+    g_emb = grads["emb"]
+    touched = np.nonzero(np.abs(g_emb).sum(1))[0].astype(np.int32)
+    newp, _ = sl.update(jnp.asarray(p0["emb"]),
+                        sl.init(jnp.asarray(p0["emb"])),
+                        jnp.asarray(touched),
+                        jnp.asarray(g_emb[touched]))
+    np.testing.assert_allclose(p1["emb"], np.asarray(newp), rtol=2e-5,
+                               atol=1e-7)
+
+
+def test_slice_adagrad_duplicate_ids_combine_before_square():
+    """Duplicates must segment-sum (or -mean) BEFORE squaring into the
+    accumulator — same as the dense cotangent would."""
+    V, D = 20, 3
+    p = jnp.ones((V, D))
+    ids = jnp.asarray([2, 2, 5], jnp.int32)
+    drows = jnp.asarray(np.arange(9, dtype=np.float32).reshape(3, 3))
+    sl = SliceAdagrad(0.1, initial_accumulator_value=1.0)
+    newp, newacc = sl.update(p, sl.init(p), ids, drows)
+    g = np.zeros((V, D), np.float32)
+    np.add.at(g, np.asarray(ids), np.asarray(drows))
+    tx = optax.adagrad(0.1, initial_accumulator_value=1.0, eps=1e-7)
+    up, _ = tx.update(jnp.asarray(g), tx.init(p), p)
+    np.testing.assert_allclose(np.asarray(newp),
+                               np.asarray(optax.apply_updates(p, up)),
+                               rtol=2e-6)
+    np.testing.assert_allclose(np.asarray(newacc[2]), 1.0 + (g[2] ** 2),
+                               rtol=1e-6)
+    # out-of-range ids (-1, V) are dropped: only row 3 may change
+    newp2, _ = sl.update(p, sl.init(p), jnp.asarray([-1, V, 3]),
+                         jnp.ones((3, D)))
+    np.testing.assert_allclose(np.asarray(newp2)[:3], np.asarray(p)[:3])
+    np.testing.assert_allclose(np.asarray(newp2)[4:], np.asarray(p)[4:])
+    assert not np.allclose(np.asarray(newp2)[3], np.asarray(p)[3])
+
+
+def test_slices_survives_batch_shape_change():
+    """A retrace (e.g. a final partial batch) must rediscover delta
+    shapes rather than reuse the first trace's."""
+    cfg = lm1b.tiny_config(keep_prob=1.0)
+    cfg.sparse_grad_mode = "slices"
+    sess, *_ = parallax.parallel_run(
+        lm1b.build_model(cfg),
+        parallax_config=parallax.Config(run_option="HYBRID",
+                                        search_partitions=False,
+                                        sparse_grad_mode="slices"))
+    r = np.random.default_rng(0)
+    l1 = sess.run("loss", feed_dict=lm1b.make_batch(r, 16, 8,
+                                                    cfg.vocab_size))
+    l2 = sess.run("loss", feed_dict=lm1b.make_batch(r, 8, 16,
+                                                    cfg.vocab_size))
+    sess.close()
+    assert np.isfinite(l1) and np.isfinite(l2)
+
+
+def test_slices_unmatched_pattern_raises():
+    """A typo'd slice_updaters pattern must fail loudly, not silently
+    train the table densely."""
+    from parallax_tpu.ops.sparse_optim import SliceAdagrad
+    cfg = lm1b.tiny_config()
+    model = lm1b.build_model(cfg)
+    model.slice_updaters = {"embedding_typo": SliceAdagrad(0.1)}
+    sess, *_ = parallax.parallel_run(
+        model, parallax_config=parallax.Config(
+            run_option="HYBRID", search_partitions=False,
+            sparse_grad_mode="slices"))
+    r = np.random.default_rng(0)
+    with pytest.raises(ValueError, match="match no param path"):
+        sess.run("loss", feed_dict=lm1b.make_batch(r, 16, 8,
+                                                   cfg.vocab_size))
+    sess.close()
+
+
+def test_bad_sparse_grad_mode_rejected():
+    with pytest.raises(ValueError, match="sparse_grad_mode"):
+        parallax.Config(sparse_grad_mode="Slices")
+
+
+def test_slices_requires_sync():
+    cfg = lm1b.tiny_config()
+    cfg.sparse_grad_mode = "slices"
+    pc = parallax.Config(run_option="HYBRID", search_partitions=False,
+                         sparse_grad_mode="slices")
+    sess, *_ = parallax.parallel_run(lm1b.build_model(cfg),
+                                     sync=False, parallax_config=pc)
+    r = np.random.default_rng(0)
+    with pytest.raises(ValueError, match="sync"):
+        # the engine builds (and validates) on the first step
+        sess.run("loss",
+                 feed_dict=lm1b.make_batch(r, 16, 8, cfg.vocab_size))
+    sess.close()

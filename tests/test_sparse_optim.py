@@ -8,7 +8,8 @@ import numpy as np
 import optax
 import pytest
 
-from parallax_tpu.ops.sparse_optim import row_sparse_adagrad
+from parallax_tpu.ops.sparse_optim import (collect_overflow_steps,
+                                           row_sparse_adagrad)
 
 V, D, K = 64, 8, 12
 
@@ -58,6 +59,25 @@ def test_update_cost_is_lower():
     dense_flops = run(optax.adagrad(lr))
     sparse_flops = run(row_sparse_adagrad(lr, max_touched_rows=k))
     assert sparse_flops < dense_flops / 2, (sparse_flops, dense_flops)
+
+
+def test_overflow_steps_counted_and_collectable(rng):
+    """Touching more rows than the bound must be visible: the state
+    counts the overflow and collect_overflow_steps surfaces it from an
+    arbitrarily nested optax state (silent drops corrupt training)."""
+    sparse = row_sparse_adagrad(0.1, max_touched_rows=K)
+    # nest inside chain + multi_transform like real model wiring
+    tx = optax.chain(optax.clip_by_global_norm(1e9), sparse)
+    p = jnp.asarray(rng.standard_normal((V, D)).astype(np.float32))
+    st = tx.init(p)
+    assert collect_overflow_steps(st) == 0
+    g_ok = _sparse_grad(rng, n_rows=K)
+    _, st = tx.update(g_ok, st, p)
+    assert collect_overflow_steps(st) == 0
+    g_over = _sparse_grad(rng, n_rows=K + 5)
+    _, st = tx.update(g_over, st, p)
+    _, st = tx.update(g_over, st, p)
+    assert collect_overflow_steps(st) == 2
 
 
 def test_rejects_non_table_params():

@@ -1,0 +1,102 @@
+"""Profile one LM1B hybrid train step on the live backend.
+
+Captures a jax.profiler trace of a few steady-state steps and then
+aggregates TPU op durations from the trace so the hotspot is readable
+without TensorBoard. Usage:
+
+    python tools/profile_lm1b.py [outdir]
+
+Prints the top-20 ops by total self-duration on the device track.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_trace(outdir: str) -> None:
+    import jax
+    import numpy as np
+    import parallax_tpu as parallax
+    from parallax_tpu.models import lm1b
+
+    n_chips = jax.device_count()
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        cfg = lm1b.tiny_config(num_partitions=n_chips)
+        bs, T = 16 * n_chips, 8
+    else:
+        cfg = lm1b.LM1BConfig(num_partitions=n_chips)
+        bs, T = 128 * n_chips, 20
+    sess, *_ = parallax.parallel_run(
+        lm1b.build_model(cfg),
+        parallax_config=parallax.Config(run_option="HYBRID",
+                                        search_partitions=False))
+    rng = np.random.default_rng(0)
+    batches = [lm1b.make_batch(rng, bs, T, cfg.vocab_size)
+               for _ in range(4)]
+    for i in range(5):
+        sess.run("loss", feed_dict=batches[i % 4])
+    jax.block_until_ready(sess.state.params)
+    with jax.profiler.trace(outdir):
+        for i in range(8):
+            sess.run("loss", feed_dict=batches[i % 4])
+        jax.block_until_ready(sess.state.params)
+    t0 = time.perf_counter()
+    for i in range(10):
+        sess.run("loss", feed_dict=batches[i % 4])
+    jax.block_until_ready(sess.state.params)
+    print(f"# step time (untraced): "
+          f"{(time.perf_counter() - t0) / 10 * 1e3:.1f} ms "
+          f"({platform}, bs={bs}, T={T})")
+    sess.close()
+
+
+def summarize(outdir: str, top: int = 25) -> None:
+    paths = glob.glob(os.path.join(outdir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not paths:
+        print("no trace.json.gz found under", outdir)
+        return
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # device tracks: pid whose process_name metadata mentions TPU/device;
+    # fall back to aggregating every complete event by name.
+    pid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", "")
+    device_pids = {p for p, n in pid_names.items()
+                   if "TPU" in n or "/device" in n.lower()}
+    totals, counts = {}, {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        if device_pids and e.get("pid") not in device_pids:
+            continue
+        name = e.get("name", "?")
+        totals[name] = totals.get(name, 0.0) + e.get("dur", 0.0)
+        counts[name] = counts.get(name, 0) + 1
+    ranked = sorted(totals.items(), key=lambda kv: -kv[1])[:top]
+    width = max((len(n) for n, _ in ranked), default=10)
+    print(f"# device tracks: "
+          f"{[pid_names[p] for p in device_pids] or 'ALL (no device pid)'}")
+    for name, us in ranked:
+        print(f"{name[:90]:<{min(width, 90)}}  "
+              f"{us / 1e3:9.2f} ms  x{counts[name]}")
+
+
+if __name__ == "__main__":
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/lm1b_profile"
+    run_trace(outdir)
+    summarize(outdir)
