@@ -32,6 +32,10 @@ def main():
                          "pipeline=GPipe stages, data=pure dp")
     ap.add_argument("--num_microbatches", type=int, default=4,
                     help="pipeline mode microbatches")
+    ap.add_argument("--pipeline_schedule", default="gpipe",
+                    choices=["gpipe", "1f1b"],
+                    help="pipeline mode: gpipe (O(M) activations) or "
+                         "1f1b (O(S) activations, fused fwd+bwd)")
     ap.add_argument("--pallas_attention", action="store_true",
                     help="fuse attention with the Pallas flash kernel "
                          "(data/tensor modes)")
@@ -46,6 +50,7 @@ def main():
                                parallelism=args.parallelism,
                                zigzag=args.zigzag,
                                num_microbatches=args.num_microbatches,
+                               pipeline_schedule=args.pipeline_schedule,
                                use_pallas_attention=args.pallas_attention)
     sess, _, worker_id, _ = parallax.parallel_run(
         lc.build_model(cfg), args.resource_info,

@@ -48,6 +48,13 @@ class LongContextConfig:
     # 'data'    : pure data parallelism (attention unsharded)
     parallelism: str = "ring"
     num_microbatches: int = 4  # pipeline mode
+    # pipeline mode schedule:
+    # 'gpipe': forward-only scan, AD transposes the backward; stores
+    #          O(M) microbatch activations per stage.
+    # '1f1b' : fused fwd+bwd 1F1B (ops/pipeline.pipeline_value_and_grad)
+    #          via Model.value_and_grad_fn; O(min(M, 2S-1)) activations,
+    #          one recompute forward per microbatch.
+    pipeline_schedule: str = "gpipe"
     # zig-zag sequence placement in ring mode: balances the causal
     # workload across the ring (each device holds a low block and its
     # mirrored high block); the engine permutes the fed ids host-side
@@ -139,6 +146,15 @@ def build_model(cfg: LongContextConfig) -> Model:
             out = full_attention_reference(q, k, v, causal=True)
         return out.reshape(B, T, D) @ p["wo"].astype(dt)
 
+    def block_apply(p, x):
+        ln = p["ln1"]
+        x = x + attention(
+            layer_norm(x, ln["s"].astype(dt), ln["b"].astype(dt)), p)
+        ln = p["ln2"]
+        h = layer_norm(x, ln["s"].astype(dt), ln["b"].astype(dt))
+        return x + (jax.nn.relu(h @ p["w1"].astype(dt))
+                    @ p["w2"].astype(dt))
+
     def loss_fn(params, batch, rng):
         ids = batch["ids"]
         B, T = ids.shape
@@ -170,15 +186,6 @@ def build_model(cfg: LongContextConfig) -> Model:
 
         x = emb_ops.embedding_lookup(params["emb"], ids).astype(dt)
         x = x + params["pos"][pos_rows].astype(dt)[None]
-
-        def block_apply(p, x):
-            ln = p["ln1"]
-            x = x + attention(
-                layer_norm(x, ln["s"].astype(dt), ln["b"].astype(dt)), p)
-            ln = p["ln2"]
-            h = layer_norm(x, ln["s"].astype(dt), ln["b"].astype(dt))
-            return x + (jax.nn.relu(h @ p["w1"].astype(dt))
-                        @ p["w2"].astype(dt))
 
         if "blocks_stacked" in params:
             from parallax_tpu.ops.pipeline import pipeline_apply
@@ -228,10 +235,77 @@ def build_model(cfg: LongContextConfig) -> Model:
         loss = jnp.sum(nll * w) / jnp.sum(w)
         return loss, {"tokens": jnp.sum(w)}
 
+    def pipeline_1f1b_vag(params, batch, rng):
+        """Fused 1F1B training step (Model.value_and_grad_fn): embedding
+        vjp'd outside the pipeline, stages + output head inside it, exact
+        gradients for every param (ops/pipeline.pipeline_value_and_grad)."""
+        ids = batch["ids"]
+        B, T = ids.shape
+        mesh = emb_ops.current_mesh()
+        n_stages = mesh.shape[AXIS_SHARD] if mesh is not None else 1
+        if mesh is None or n_stages == 1:
+            (loss, metrics), grads = jax.value_and_grad(
+                lambda p: loss_fn(p, batch, rng),
+                has_aux=True)(params)
+            return loss, metrics, grads
+        if cfg.num_layers % n_stages:
+            raise ValueError(
+                f"pipeline parallelism needs num_layers "
+                f"({cfg.num_layers}) divisible by the "
+                f"{n_stages}-stage shard axis")
+        per_stage = cfg.num_layers // n_stages
+
+        labels = jnp.concatenate(
+            [ids[:, 1:], jnp.zeros((B, 1), ids.dtype)], axis=1)
+        w = jnp.concatenate(
+            [jnp.ones((B, T - 1)), jnp.zeros((B, 1))], axis=1)
+
+        def embed(emb, pos):
+            x = emb_ops.embedding_lookup(emb, ids).astype(dt)
+            return x + pos[:T].astype(dt)[None]
+
+        x, pull_embed = jax.vjp(embed, params["emb"], params["pos"])
+        staged = jax.tree.map(
+            lambda p: p.reshape((n_stages, per_stage) + p.shape[1:]),
+            params["blocks_stacked"])
+
+        def stage_fn(sp, xx):
+            for j in range(per_stage):
+                xx = block_apply(jax.tree.map(lambda p: p[j], sp), xx)
+            return xx
+
+        def mb_loss(head, out, y_mb):
+            logits = out.astype(jnp.float32) @ head["out_w"]
+            nll = optax.softmax_cross_entropy_with_integer_labels(
+                logits.reshape(-1, logits.shape[-1]),
+                y_mb["labels"].reshape(-1))
+            wf = y_mb["w"].reshape(-1)
+            # every row carries T-1 real tokens, so each microbatch's
+            # weighted mean == its share of the global weighted mean
+            return jnp.sum(nll * wf) / jnp.maximum(jnp.sum(wf), 1e-8)
+
+        from parallax_tpu.ops.pipeline import pipeline_value_and_grad
+        loss, (g_stage, g_head, g_x) = pipeline_value_and_grad(
+            stage_fn, mb_loss, staged, x, {"labels": labels, "w": w},
+            mesh, cfg.num_microbatches,
+            head_params={"out_w": params["out_w"]})
+        g_emb, g_pos = pull_embed(g_x)
+        grads = {
+            "emb": g_emb, "pos": g_pos, "out_w": g_head["out_w"],
+            "blocks_stacked": jax.tree.map(
+                lambda g: g.reshape((cfg.num_layers,) + g.shape[2:]),
+                g_stage),
+        }
+        return loss, {"tokens": jnp.sum(w)}, grads
+
     if cfg.parallelism not in ("ring", "tensor", "pipeline", "data"):
         raise ValueError(
             f"unknown parallelism {cfg.parallelism!r}; expected "
             f"'ring', 'tensor', 'pipeline' or 'data'")
+    if cfg.pipeline_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"unknown pipeline_schedule {cfg.pipeline_schedule!r}; "
+            f"expected 'gpipe' or '1f1b'")
     tx = optax.chain(optax.clip_by_global_norm(1.0),
                      optax.adam(cfg.learning_rate))
     if cfg.parallelism == "pipeline":
@@ -241,7 +315,10 @@ def build_model(cfg: LongContextConfig) -> Model:
             init_fn, loss_fn, optimizer=tx,
             dense_params=("emb", "pos"),
             batch_specs={"ids": P(AXIS_REPL, None)},
-            param_specs={"blocks_stacked/*": P(AXIS_SHARD)})
+            param_specs={"blocks_stacked/*": P(AXIS_SHARD)},
+            value_and_grad_fn=(pipeline_1f1b_vag
+                               if cfg.pipeline_schedule == "1f1b"
+                               else None))
     if cfg.parallelism == "tensor":
         # Megatron-style TP: qkv/up-proj column-parallel, out/down-proj
         # row-parallel over 'shard'; batch data-parallel over 'repl'.

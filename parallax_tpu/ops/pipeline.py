@@ -7,18 +7,28 @@ microbatches flow through the stage ring with one `ppermute` hop per
 tick. All devices execute the same SPMD program; a device is "active"
 for tick t iff its stage s has a microbatch in flight (0 <= t - s < M).
 
-Differentiable end-to-end: the tick loop is a `lax.scan` and activation
-hops are `ppermute`, both transposable, so reverse-mode AD runs the
-pipeline backwards (the 1F1B-style backward schedule emerges from the
-transpose).
+Two schedules:
 
-Cost model: wall-clock ticks = M + S - 1 (bubble fraction
-(S-1)/(M+S-1)); per-tick comm = one activation microbatch per ICI hop.
+* `pipeline_apply` — GPipe. Differentiable end-to-end: the tick loop is
+  a `lax.scan` and activation hops are `ppermute`, both transposable, so
+  reverse-mode AD runs the pipeline backwards. Memory: the scan stores
+  every tick's residuals, i.e. O(M) in-flight microbatch activations per
+  stage.
+* `pipeline_value_and_grad` — 1F1B with recompute. The loss is fused
+  into the last stage so microbatch m's backward starts the moment it
+  clears stage S-1; in-flight activation storage is a ring buffer of
+  min(M, 2S-1) stage *inputs* per device (O(S), independent of M), at
+  the cost of one extra stage forward per microbatch (rematerialized in
+  the backward tick — the Megatron-LM "full recompute" tradeoff).
+
+Cost model (both): wall-clock ticks scale as M + O(S) with bubble
+fraction (S-1)/(M+S-1); per-tick comm = one activation microbatch (plus,
+for 1F1B, one cotangent microbatch) per ICI hop.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -105,3 +115,181 @@ def pipeline_apply(stage_fn: Callable,
         in_specs=(spec_params, P(AXIS_REPL)),
         out_specs=P(AXIS_REPL),
     )(stage_params, x)
+
+
+def inflight_buffer_size(num_stages: int, num_microbatches: int) -> int:
+    """Per-device in-flight activation slots under the 1F1B schedule.
+
+    Stage s forwards microbatch m at tick m+s and backwards it at tick
+    m + 2(S-1) - s, so at most 2(S-1-s)+1 microbatch inputs are live at
+    once — bounded by 2S-1 regardless of M (GPipe stores all M)."""
+    return min(num_microbatches, 2 * num_stages - 1)
+
+
+def pipeline_value_and_grad(stage_fn: Callable,
+                            loss_fn: Callable,
+                            stage_params,
+                            x: jax.Array,
+                            y,
+                            mesh: Mesh,
+                            num_microbatches: int,
+                            head_params=None):
+    """Fused forward+backward 1F1B pipeline training step.
+
+    * ``stage_fn(params_one_stage, activation) -> activation`` — as in
+      `pipeline_apply`; activation shapes match across stages.
+    * ``loss_fn(head_params, out_mb, y_mb) -> scalar`` — mean-style loss
+      on one microbatch of last-stage outputs; ``head_params`` holds any
+      loss-side weights (e.g. the output projection), replicated across
+      the mesh. The returned loss is the mean over microbatches (== the
+      full-batch mean for equal microbatches).
+    * ``stage_params`` — stacked [S, ...] leaves sharded P('shard', ...).
+    * ``x`` [B, ...], ``y`` pytree of [B, ...] — batch, split over
+      'repl' (data parallel) then into M microbatches.
+
+    Returns ``(loss, (g_stage, g_head, g_x))``: gradients for the
+    stacked stage params, the head params, and the pipeline input ``x``
+    (the cotangent to chain into whatever produced ``x`` — e.g. an
+    embedding lookup — via its own vjp). All are gradients of the
+    returned (global-mean) loss; math matches sequential execution.
+
+    Backward rematerializes each stage forward from the buffered stage
+    input, so peak activation memory is O(min(M, 2S-1)) microbatches per
+    device instead of GPipe's O(M).
+
+    Schedule: tick t runs, on stage s, forward of microbatch mf = t - s
+    and backward of microbatch mb = t - 2(S-1) + s (when in range); the
+    last stage computes its loss cotangent in the same tick its forward
+    completes — the defining 1F1B property. Activations hop s -> s+1 and
+    cotangents hop s -> s-1, one `ppermute` each per tick.
+    """
+    S = mesh.shape[AXIS_SHARD]
+    M = num_microbatches
+    B = x.shape[0]
+    repl = mesh.shape[AXIS_REPL]
+    if (B // max(repl, 1)) % M or B % max(repl, 1):
+        raise ValueError(
+            f"per-replica batch {B}/{repl} must be divisible by "
+            f"num_microbatches={M}")
+    Bbuf = inflight_buffer_size(S, M)
+    if head_params is None:
+        head_params = {}
+
+    def local(params_local, head_local, x_local, y_local):
+        s = jax.lax.axis_index(AXIS_SHARD)
+        mb = x_local.shape[0] // M
+        xm = x_local.reshape((M, mb) + x_local.shape[1:])
+        ym = jax.tree.map(
+            lambda a: a.reshape((M, mb) + a.shape[1:]), y_local)
+        my_params = jax.tree.map(lambda p: p[0], params_local)
+        # Declare params varying over the axes they are invariant on:
+        # otherwise every tick's pullback gets an automatic psum over
+        # those axes inserted by the transpose — a per-tick collective,
+        # and a double-count with the one reduction we do at the end.
+        my_params = jax.tree.map(
+            lambda p: jax.lax.pcast(p, (AXIS_REPL,), to="varying"),
+            my_params)
+
+        def vary_all(a):
+            for ax in (AXIS_REPL, AXIS_SHARD):
+                a = jax.lax.pcast(a, (ax,), to="varying")
+            return a
+
+        head_v = jax.tree.map(vary_all, head_local)
+
+        act0 = vary_all(jnp.zeros(xm.shape[1:], xm.dtype))
+        ct0 = vary_all(jnp.zeros(xm.shape[1:], xm.dtype))
+        buf0 = vary_all(jnp.zeros((Bbuf,) + xm.shape[1:], xm.dtype))
+        gacc0 = jax.tree.map(
+            lambda p: vary_all(jnp.zeros(p.shape, p.dtype)), my_params)
+        hacc0 = jax.tree.map(
+            lambda p: vary_all(jnp.zeros(p.shape, p.dtype)), head_v)
+        xg0 = vary_all(jnp.zeros(xm.shape, xm.dtype))
+        lacc0 = vary_all(jnp.zeros((), jnp.float32))
+
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            act_in, ct_in, buf, gacc, hacc, xg, lacc = carry
+            # ---- forward of microbatch mf ----
+            mf = t - s
+            fwd_active = (mf >= 0) & (mf < M)
+            mf_s = jnp.clip(mf, 0, M - 1)
+            inp = jnp.where(s == 0, jax.lax.dynamic_index_in_dim(
+                xm, mf_s, axis=0, keepdims=False), act_in)
+            slot_f = jnp.mod(mf_s, Bbuf)
+            old = jax.lax.dynamic_index_in_dim(buf, slot_f, 0,
+                                               keepdims=False)
+            buf = jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.where(fwd_active, inp, old), slot_f, axis=0)
+            out = stage_fn(my_params, inp)
+            # ---- backward of microbatch mb (rematerialized) ----
+            mb_i = t - (2 * (S - 1) - s)
+            bwd_active = (mb_i >= 0) & (mb_i < M)
+            mb_s = jnp.clip(mb_i, 0, M - 1)
+            inp_b = jax.lax.dynamic_index_in_dim(buf, jnp.mod(mb_s, Bbuf),
+                                                 0, keepdims=False)
+            out_b, pull = jax.vjp(stage_fn, my_params, inp_b)
+            y_mb = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, mb_s, 0, keepdims=False), ym)
+            loss_m, (g_head, ct_loss) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(head_v, out_b, y_mb)
+            last_b = bwd_active & (s == S - 1)
+            hacc = jax.tree.map(
+                lambda h, g: h + jnp.where(last_b, g / M,
+                                           jnp.zeros_like(g)),
+                hacc, g_head)
+            ct = jnp.where(s == S - 1,
+                           ct_loss.astype(ct_in.dtype) / M, ct_in)
+            dparams, dinp = pull(ct)
+            dparams = jax.tree.map(
+                lambda g: jnp.where(bwd_active, g, jnp.zeros_like(g)),
+                dparams)
+            gacc = jax.tree.map(jnp.add, gacc, dparams)
+            lacc = lacc + jnp.where(last_b, loss_m / M, 0.0)
+            # stage 0's input cotangent is d loss / d x[mb]
+            rec_x = bwd_active & (s == 0)
+            old_xg = jax.lax.dynamic_index_in_dim(xg, mb_s, 0,
+                                                  keepdims=False)
+            xg = jax.lax.dynamic_update_index_in_dim(
+                xg, jnp.where(rec_x, dinp.astype(xg.dtype), old_xg),
+                mb_s, axis=0)
+            # ---- hops ----
+            out = jnp.where(fwd_active, out, jnp.zeros_like(out))
+            act_next = jax.lax.ppermute(out, AXIS_SHARD, fwd_perm)
+            dinp = jnp.where(bwd_active, dinp, jnp.zeros_like(dinp))
+            ct_next = jax.lax.ppermute(dinp, AXIS_SHARD, bwd_perm)
+            return (act_next, ct_next, buf, gacc, hacc, xg, lacc), None
+
+        n_ticks = M + 2 * (S - 1)
+        (_, _, _, gacc, hacc, xg, lacc), _ = jax.lax.scan(
+            tick, (act0, ct0, buf0, gacc0, hacc0, xg0, lacc0),
+            jnp.arange(n_ticks))
+        # loss lives on the last stage; data-parallel rows average
+        loss = jax.lax.psum(lacc, AXIS_SHARD)
+        loss = jax.lax.pmean(loss, AXIS_REPL)
+        g_stage = jax.tree.map(
+            lambda g: jax.lax.pmean(g, AXIS_REPL)[None], gacc)
+        # head grads live on the last stage only (masked elsewhere)
+        g_head = jax.tree.map(
+            lambda g: jax.lax.pmean(jax.lax.psum(g, AXIS_SHARD),
+                                    AXIS_REPL), hacc)
+        # x cotangent lives on stage 0; scale to the global-mean loss
+        # (each row accumulated d(row-mean)/dx; loss averages the rows)
+        xg = jax.lax.psum(xg, AXIS_SHARD) / repl
+        g_x = xg.reshape(x_local.shape)
+        return loss, g_stage, g_head, g_x
+
+    spec_params = jax.tree.map(
+        lambda p: P(*((AXIS_SHARD,) + (None,) * (p.ndim - 1))),
+        stage_params)
+    head_specs = jax.tree.map(lambda _: P(), head_params)
+    y_specs = jax.tree.map(lambda _: P(AXIS_REPL), y)
+    loss, g_stage, g_head, g_x = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(spec_params, head_specs, P(AXIS_REPL), y_specs),
+        out_specs=(P(), spec_params, head_specs, P(AXIS_REPL)),
+    )(stage_params, head_params, x, y)
+    return loss, (g_stage, g_head, g_x)

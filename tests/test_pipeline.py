@@ -68,10 +68,59 @@ def test_gradients_match_sequential(rng):
                                    atol=5e-6, err_msg=name)
 
 
+@pytest.mark.parametrize("n_stages,M", [(1, 4), (2, 4), (4, 4), (4, 8)])
+def test_1f1b_matches_sequential(rng, n_stages, M):
+    """1F1B fused loss+grads (stage, head, AND input cotangent) ==
+    sequential forward + autodiff."""
+    mesh = mesh_lib.build_mesh(num_partitions=n_stages)
+    params = _stacked_params(rng, n_stages)
+    head = {"wout": jnp.asarray(
+        rng.standard_normal((D, D)).astype(np.float32)) * 0.3}
+    B = mesh.shape["repl"] * M * 2
+    x = jnp.asarray(rng.standard_normal((B, D)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((B, D)).astype(np.float32))
+
+    def mb_loss(head, out, y_mb):
+        return jnp.mean((out @ head["wout"] - y_mb) ** 2)
+
+    loss, (g_stage, g_head, g_x) = jax.jit(
+        lambda p, h, x, y: pp.pipeline_value_and_grad(
+            _stage_fn, mb_loss, p, x, y, mesh, M, head_params=h)
+    )(params, head, x, y)
+
+    def seq_loss(params, head, x):
+        out = _sequential(params, x, n_stages)
+        return jnp.mean((out @ head["wout"] - y) ** 2)
+
+    eloss, (ep, eh, ex) = jax.value_and_grad(seq_loss, argnums=(0, 1, 2))(
+        params, head, x)
+    np.testing.assert_allclose(float(loss), float(eloss), rtol=2e-5)
+    for name in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g_stage[name]),
+                                   np.asarray(ep[name]), rtol=5e-4,
+                                   atol=5e-6, err_msg=name)
+    np.testing.assert_allclose(np.asarray(g_head["wout"]),
+                               np.asarray(eh["wout"]), rtol=5e-4,
+                               atol=5e-6)
+    np.testing.assert_allclose(np.asarray(g_x), np.asarray(ex),
+                               rtol=5e-4, atol=5e-6)
+
+
+def test_1f1b_buffer_is_o_s_not_o_m():
+    """The in-flight buffer bound is 2S-1 slots, independent of M."""
+    assert pp.inflight_buffer_size(num_stages=4, num_microbatches=64) == 7
+    assert pp.inflight_buffer_size(num_stages=2, num_microbatches=128) == 3
+    # small-M clamp: never allocate more slots than microbatches
+    assert pp.inflight_buffer_size(num_stages=8, num_microbatches=4) == 4
+
+
 @pytest.mark.slow
-def test_pipeline_lm_through_engine(rng):
-    """'pipeline' mode: stages sharded over 'shard', trajectory matches
-    pure data parallelism (same math, pipelined schedule)."""
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_lm_through_engine(rng, schedule):
+    """'pipeline' mode (both schedules): stages sharded over 'shard',
+    trajectory matches pure data parallelism (same math, pipelined
+    schedule; 1F1B additionally fuses the backward via
+    Model.value_and_grad_fn)."""
     import parallax_tpu as parallax
     from parallax_tpu.models import long_context as lc
 
@@ -81,6 +130,7 @@ def test_pipeline_lm_through_engine(rng):
         cfg = lc.tiny_config(num_layers=4, max_len=16)
         cfg.parallelism = parallelism
         cfg.num_microbatches = 2
+        cfg.pipeline_schedule = schedule
         sess, *_ = parallax.parallel_run(
             lc.build_model(cfg),
             parallax_config=parallax.Config(run_option="HYBRID",
