@@ -35,6 +35,9 @@ class MoeLMConfig:
     num_layers: int = 6
     max_len: int = 1024
     capacity_factor: float = 1.25
+    # 1 = switch routing; 2 = GShard top-2 (renormalized gates,
+    # first-choice capacity priority)
+    top_k: int = 1
     aux_loss_weight: float = 0.01
     use_pallas_attention: bool = False
     learning_rate: float = 3e-4
@@ -113,14 +116,15 @@ def build_model(cfg: MoeLMConfig) -> Model:
         mesh = emb_ops.current_mesh()
         x = emb_ops.embedding_lookup(params["emb"], ids).astype(dt)
         x = x + params["pos"][:T].astype(dt)[None]
-        aux_total = 0.0
+        aux_total, drop_total = 0.0, 0.0
         for p in params["blocks"]:
             x = layer_norm(x + attention(x, p), p["ln1"])
             tokens = x.reshape(B * T, D)
-            moe_out, aux = moe_ops.switch_moe(
+            moe_out, aux, dropped = moe_ops.switch_moe(
                 tokens, p["router"], p["moe_w1"], p["moe_w2"], mesh,
-                cfg.capacity_factor)
+                cfg.capacity_factor, top_k=cfg.top_k)
             aux_total = aux_total + aux
+            drop_total = drop_total + dropped
             x = layer_norm(x + moe_out.reshape(B, T, D).astype(dt),
                            p["ln2"])
         logits = x.astype(jnp.float32) @ params["out_w"]
@@ -134,7 +138,10 @@ def build_model(cfg: MoeLMConfig) -> Model:
         lm_loss = jnp.sum(nll * w) / jnp.sum(w)
         aux_mean = aux_total / cfg.num_layers
         loss = lm_loss + cfg.aux_loss_weight * aux_mean
-        return loss, {"lm_loss": lm_loss, "aux_loss": aux_mean}
+        # surface capacity overflow as a metric — silent token drops
+        # corrupt training with no signal otherwise
+        return loss, {"lm_loss": lm_loss, "aux_loss": aux_mean,
+                      "moe_dropped": drop_total / cfg.num_layers}
 
     tx = optax.chain(optax.clip_by_global_norm(1.0),
                      optax.adam(cfg.learning_rate))
