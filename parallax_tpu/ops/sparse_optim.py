@@ -171,6 +171,9 @@ class SliceAdagrad:
             gsum = gsum * jnp.where(
                 cnt > 0, 1.0 / jnp.maximum(cnt, 1.0), 0.0
             )[:, None].astype(gsum.dtype)
+        # NOTE: deliberately NO unique_indices/indices_are_sorted hints:
+        # measured on v5e, the hinted scatter lowers ~3x SLOWER than the
+        # plain one for these shapes (bench 291k -> 90k words/sec/chip)
         acc_rows = acc.at[uids, :].get(mode="fill", fill_value=0.0)
         acc_rows = acc_rows + gsum * gsum
         inv_rt = jnp.where(acc_rows > 0,

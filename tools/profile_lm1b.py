@@ -30,16 +30,20 @@ def run_trace(outdir: str) -> None:
 
     n_chips = jax.device_count()
     platform = jax.devices()[0].platform
+    mode = os.environ.get("PARALLAX_PROFILE_GRAD_MODE", "slices")
     if platform == "cpu":
-        cfg = lm1b.tiny_config(num_partitions=n_chips)
+        cfg = lm1b.tiny_config(num_partitions=n_chips,
+                               sparse_grad_mode=mode)
         bs, T = 16 * n_chips, 8
     else:
-        cfg = lm1b.LM1BConfig(num_partitions=n_chips)
+        cfg = lm1b.LM1BConfig(num_partitions=n_chips,
+                              sparse_grad_mode=mode)
         bs, T = 128 * n_chips, 20
     sess, *_ = parallax.parallel_run(
         lm1b.build_model(cfg),
         parallax_config=parallax.Config(run_option="HYBRID",
-                                        search_partitions=False))
+                                        search_partitions=False,
+                                        sparse_grad_mode=mode))
     rng = np.random.default_rng(0)
     batches = [lm1b.make_batch(rng, bs, T, cfg.vocab_size)
                for _ in range(4)]
