@@ -176,10 +176,19 @@ def restore_train_state(ckpt_dir: str, model, seed: int = 0,
         restored = mngr.restore(latest,
                                 args=ocp.args.StandardRestore(abstract))
     except (ValueError, TypeError):
-        # sync=False checkpoints carry a params-shaped pending_grads
-        # subtree (engine.TrainState); retry with the async template.
+        # sync=False checkpoints carry a pending_grads subtree
+        # (engine.TrainState): params-shaped at staleness=1, or a
+        # [k, ...]-stacked gradient ring at staleness=k. Retry with the
+        # matching async template.
+        k = int(getattr(config, "staleness", 1) or 1)
+
+        def pending_like(p):
+            p = jnp.asarray(p)
+            shape = p.shape if k == 1 else (k,) + p.shape
+            return jnp.zeros(shape, p.dtype)
+
         template = template.replace(pending_grads=jax.tree.map(
-            lambda x: jnp.zeros_like(jnp.asarray(x)), template.params))
+            pending_like, template.params))
         abstract = jax.tree.map(as_abstract, template)
         restored = mngr.restore(latest,
                                 args=ocp.args.StandardRestore(abstract))

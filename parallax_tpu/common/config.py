@@ -138,6 +138,11 @@ class ParallaxConfig:
 
     run_option: str = consts.RUN_HYBRID
     sparse_grad_mode: str = "dense"
+    # sync=False only: gradient staleness bound k — each step applies
+    # the gradients computed k steps earlier (deterministic SPMD
+    # emulation of the reference's async PS, whose staleness was
+    # unbounded). Costs k extra parameter-sized buffers.
+    staleness: int = 1
     average_sparse: bool = False
     sess_config: Any = None
     redirect_path: Optional[str] = None
@@ -162,6 +167,9 @@ class ParallaxConfig:
             raise ValueError(
                 f"sparse_grad_mode must be 'dense' or 'slices', got "
                 f"{self.sparse_grad_mode!r}")
+        if int(self.staleness) < 1:
+            raise ValueError(
+                f"staleness must be >= 1, got {self.staleness}")
 
     # Reference-style setters (kept so ported driver code works unchanged).
     def set_sync(self, sync: bool) -> None:

@@ -290,9 +290,14 @@ class Engine:
         if not config.sync:
             parallax_log.info(
                 "sync=False: running bounded-staleness delayed-gradient "
-                "training (each step applies the previous step's "
-                "gradients) — the deterministic SPMD emulation of the "
-                "reference's async PS mode.")
+                "training (each step applies the gradients computed %d "
+                "step(s) earlier) — the deterministic SPMD emulation of "
+                "the reference's async PS mode.", int(config.staleness))
+        elif int(config.staleness) > 1:
+            raise ValueError(
+                f"staleness={config.staleness} has no effect with "
+                f"sync=True; pass sync=False to parallel_run for "
+                f"bounded-staleness training")
         self._debug_nans_was = None
         if config.debug_nans:
             self._debug_nans_was = bool(jax.config.jax_debug_nans)
@@ -449,15 +454,25 @@ class Engine:
             params = jax.lax.with_sharding_constraint(params,
                                                       param_shardings)
             opt_state = tx.init(params)
-            pending = (None if config.sync
-                       else jax.tree.map(jnp.zeros_like, params))
+            k = int(config.staleness)
+            if config.sync:
+                pending = None
+            elif k == 1:
+                pending = jax.tree.map(jnp.zeros_like, params)
+            else:
+                # ring of k gradient buffers: slot t % k holds the
+                # gradients computed at step t, applied at step t + k
+                pending = jax.tree.map(
+                    lambda p: jnp.zeros((k,) + p.shape, p.dtype), params)
             slice_state = None
             if slice_resolved:
-                # accumulators follow their table's sharding (otherwise
-                # a [V, D] acc would replicate per device on a pod)
+                # accumulators/moments follow their table's sharding
+                # (otherwise a [V, D] state leaf would replicate per
+                # device on a pod); scalar leaves (step counters) pass
                 slice_state = {
-                    path: jax.lax.with_sharding_constraint(
+                    path: _constrain_like_table(
                         upd.init(_get_path(params, path)),
+                        _get_path(params, path),
                         _get_path(param_shardings, path))
                     for path, upd in slice_resolved.items()}
             return TrainState(step=jnp.zeros((), jnp.int32), params=params,
@@ -521,13 +536,23 @@ class Engine:
                     (grads, gdeltas) = jax.value_and_grad(
                         loss_wrap, argnums=(0, 1),
                         has_aux=True)(state.params, deltas0)
+            k = int(config.staleness)
             if config.sync:
                 apply_grads, pending = grads, None
-            else:
+            elif k == 1:
                 # delayed-gradient: apply last step's grads (computed
                 # against the stale params, like an async PS push that
                 # lands one update late); stash this step's for the next
                 apply_grads, pending = state.pending_grads, grads
+            else:
+                # staleness k: slot t % k was written at step t - k
+                slot = jnp.mod(state.step, k)
+                apply_grads = jax.tree.map(
+                    lambda b: jax.lax.dynamic_index_in_dim(
+                        b, slot, 0, keepdims=False), state.pending_grads)
+                pending = jax.tree.map(
+                    lambda b, g: jax.lax.dynamic_update_index_in_dim(
+                        b, g, slot, axis=0), state.pending_grads, grads)
             updates, opt_state = tx.update(
                 apply_grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
@@ -552,8 +577,9 @@ class Engine:
                         table, slice_state[path], ids_cat, drows_cat,
                         average=avg)
                     params = _set_path(params, path, new_table)
-                    slice_state[path] = jax.lax.with_sharding_constraint(
-                        new_acc, _get_path(param_shardings, path))
+                    slice_state[path] = _constrain_like_table(
+                        new_acc, table,
+                        _get_path(param_shardings, path))
             params = jax.lax.with_sharding_constraint(params,
                                                       param_shardings)
             new_state = state.replace(step=state.step + 1, params=params,
@@ -724,6 +750,16 @@ def _dtype_of(x):
     if d is not None:
         return d
     return np.asarray(x).dtype
+
+
+def _constrain_like_table(state, table, sharding):
+    """Apply the table's sharding to every state leaf shaped like the
+    table (adagrad accs, adam moments); leave other leaves (step
+    counters) unconstrained."""
+    return jax.tree.map(
+        lambda x: (jax.lax.with_sharding_constraint(x, sharding)
+                   if getattr(x, "shape", None) == table.shape else x),
+        state)
 
 
 def _get_path(tree, path: str):

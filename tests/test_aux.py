@@ -199,6 +199,29 @@ class TestMoreTriggers:
         assert np.asarray(restored.params["w"]).shape == \
             np.asarray(restored.pending_grads["w"]).shape
 
+    def test_restore_async_checkpoint_staleness_k(self, tmp_path, rng):
+        """staleness=k checkpoints carry a [k, ...] gradient ring; the
+        restore fallback must rebuild that layout (config carries k)."""
+        from parallax_tpu.checkpoint import restore_train_state
+        ckpt_dir = str(tmp_path / "ckpt_async_k")
+        model = simple.build_model(0.1)
+        cfg = parallax.Config(
+            run_option="AR", search_partitions=False, staleness=2,
+            ckpt_config=parallax.CheckPointConfig(ckpt_dir=ckpt_dir,
+                                                  save_ckpt_steps=2))
+        sess, *_ = parallax.parallel_run(model, None, sync=False,
+                                         parallax_config=cfg)
+        _run_steps(sess, rng, 2)
+        sess.close()
+        restored, step = restore_train_state(
+            ckpt_dir, simple.build_model(0.1),
+            config=parallax.Config(run_option="AR",
+                                   search_partitions=False, staleness=2))
+        assert step == 2
+        w_shape = np.asarray(restored.params["w"]).shape
+        assert np.asarray(restored.pending_grads["w"]).shape == \
+            (2,) + w_shape
+
     def test_secs_trigger_is_broadcast_multiprocess(self, tmp_path,
                                                     monkeypatch):
         """Secs-due is decided by process 0 and broadcast: a host whose

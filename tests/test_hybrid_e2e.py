@@ -180,6 +180,48 @@ def test_local_aggregation_wire_bytes_and_parity(rng):
     np.testing.assert_allclose(emb_agg, emb_raw, rtol=1e-4, atol=1e-6)
 
 
+def test_sync_false_staleness_k(rng):
+    """Config(staleness=k) applies gradients k steps late: the first k
+    steps apply zeros, then step t applies g(params at t-k)."""
+    lr, k = 0.1, 2
+    batches = _batches(rng, 7)
+    model = _make_model(lr)
+
+    params = model.init_fn(jax.random.PRNGKey(0))
+    init_params = jax.tree.map(np.asarray, params)
+    fifo = [jax.tree.map(jnp.zeros_like, params) for _ in range(k)]
+    ref_losses = []
+    for t, b in enumerate(batches):
+        def lf(p):
+            return model.call_loss(p, {kk: jnp.asarray(v)
+                                       for kk, v in b.items()}, None)[0]
+        loss, grads = jax.value_and_grad(lf)(params)
+        params = jax.tree.map(lambda p, g: p - lr * g, params,
+                              fifo[t % k])
+        fifo[t % k] = grads
+        ref_losses.append(float(loss))
+
+    sess, *_ = parallax.parallel_run(
+        _make_model(lr), None, sync=False,
+        parallax_config=parallax.Config(run_option="HYBRID",
+                                        search_partitions=False,
+                                        staleness=k))
+    losses = []
+    for i, b in enumerate(batches):
+        losses.append(sess.run("loss", feed_dict=b))
+        if i < k:
+            # zero updates until the first stored grads come due
+            jax.tree.map(
+                lambda a, b_: np.testing.assert_allclose(
+                    np.asarray(a), b_, rtol=1e-6),
+                sess.state.params, init_params)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    jax.tree.map(lambda a, b_: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-6),
+        sess.state.params, params)
+    sess.close()
+
+
 def test_sync_false_is_delayed_gradient(rng):
     """sync=False (reference async PS) = bounded-staleness delayed
     gradients: params_{t+1} = params_t - lr * g(params_{t-1}); the first
