@@ -555,7 +555,17 @@ class Engine:
                         b, g, slot, axis=0), state.pending_grads, grads)
             updates, opt_state = tx.update(
                 apply_grads, state.opt_state, state.params)
-            params = optax.apply_updates(state.params, updates)
+            if slice_resolved:
+                # don't route slice tables through apply_updates: their
+                # masked update is zero, but table + 0 still costs a
+                # full [V, D] buffer write per step
+                params = jax.tree_util.tree_map_with_path(
+                    lambda kp, p, u: (
+                        p if classify._pathname(kp) in slice_resolved
+                        else optax.apply_updates(p, u)),
+                    state.params, updates)
+            else:
+                params = optax.apply_updates(state.params, updates)
             slice_state = state.slice_state
             if slice_resolved:
                 # scatter-only table updates from the captured slices
