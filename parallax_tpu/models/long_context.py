@@ -55,6 +55,15 @@ class LongContextConfig:
     #          via Model.value_and_grad_fn; O(min(M, 2S-1)) activations,
     #          one recompute forward per microbatch.
     pipeline_schedule: str = "gpipe"
+    # Interleaved (virtual-stage) scheduling: each device holds
+    # virtual_stages non-adjacent layer chunks, cutting the pipeline
+    # bubble virtual_stages-fold (ops/pipeline.py). Because the chunk
+    # assignment depends on the stage count, virtual_stages > 1 requires
+    # declaring ``pipeline_stages`` (the 'shard' mesh axis size the
+    # model will run on); layers are then STORED in device-major stage
+    # order at init so no in-graph cross-shard permute is ever needed.
+    virtual_stages: int = 1
+    pipeline_stages: Optional[int] = None
     # zig-zag sequence placement in ring mode: balances the causal
     # workload across the ring (each device holds a low block and its
     # mirrored high block); the engine permutes the fed ids host-side
@@ -86,6 +95,38 @@ def build_model(cfg: LongContextConfig) -> Model:
     if cfg.zigzag and cfg.parallelism != "ring":
         raise ValueError(
             "zigzag placement only applies to parallelism='ring'")
+    Vp = int(cfg.virtual_stages)
+    if Vp > 1:
+        if cfg.parallelism != "pipeline":
+            raise ValueError(
+                "virtual_stages > 1 only applies to "
+                "parallelism='pipeline'")
+        if not cfg.pipeline_stages:
+            raise ValueError(
+                "virtual_stages > 1 requires pipeline_stages (the "
+                "'shard' mesh axis size) so the device-major layer "
+                "order is fixed at init")
+        if cfg.num_layers % (cfg.pipeline_stages * Vp):
+            raise ValueError(
+                f"num_layers ({cfg.num_layers}) must divide into "
+                f"pipeline_stages*virtual_stages = "
+                f"{cfg.pipeline_stages}*{Vp}")
+
+    def _layer_storage_order():
+        """Original layer index stored at each row of blocks_stacked.
+
+        Identity for V=1; for interleaving, rows follow the device-major
+        stage order (ops/pipeline.stage_order_permutation) with each
+        stage's layers contiguous."""
+        L = cfg.num_layers
+        if Vp == 1:
+            return list(range(L))
+        from parallax_tpu.ops.pipeline import stage_order_permutation
+        S = cfg.pipeline_stages
+        pc = L // (S * Vp)
+        return [g * pc + j
+                for g in stage_order_permutation(S, Vp)
+                for j in range(pc)]
 
     def _zigzag_active(mesh) -> bool:
         return (cfg.zigzag and cfg.parallelism == "ring"
@@ -113,12 +154,45 @@ def build_model(cfg: LongContextConfig) -> Model:
             "out_w": dense_init(ks[1], (D, V)),
         }
         if cfg.parallelism == "pipeline":
-            # stacked layout [L, ...] so layer stages shard over 'shard'
+            # stacked layout [L, ...] so layer stages shard over
+            # 'shard'; rows in storage order (device-major when
+            # interleaving — a one-time permute here instead of a
+            # per-step cross-shard gather)
+            order = _layer_storage_order()
             params["blocks_stacked"] = jax.tree.map(
-                lambda *leaves: jnp.stack(leaves), *blocks)
+                lambda *leaves: jnp.stack([leaves[i] for i in order]),
+                *blocks)
         else:
             params["blocks"] = blocks
         return params
+
+    def _stage_pipeline(stacked, n_stages):
+        """Validate the stage split and return (staged, stage_fn):
+        leaves reshaped [S*V, per_stage, ...] plus the per-stage apply
+        (shared by the GPipe loss path and the 1F1B fused path)."""
+        if Vp > 1 and n_stages != cfg.pipeline_stages:
+            raise ValueError(
+                f"model was built for pipeline_stages="
+                f"{cfg.pipeline_stages} but the mesh shard axis is "
+                f"{n_stages}")
+        if cfg.num_layers % (n_stages * Vp):
+            raise ValueError(
+                f"pipeline parallelism needs num_layers "
+                f"({cfg.num_layers}) divisible by the "
+                f"{n_stages}-stage shard axis (x{Vp} virtual)")
+        per_stage = cfg.num_layers // (n_stages * Vp)
+
+        def stage_fn(stage_params, x):
+            # stage_params leaves: [per_stage, ...]
+            for j in range(per_stage):
+                x = block_apply(
+                    jax.tree.map(lambda p: p[j], stage_params), x)
+            return x
+
+        staged = jax.tree.map(
+            lambda p: p.reshape((n_stages * Vp, per_stage)
+                                + p.shape[1:]), stacked)
+        return staged, stage_fn
 
     def layer_norm(x, s, b):
         m = jnp.mean(x, -1, keepdims=True)
@@ -193,29 +267,18 @@ def build_model(cfg: LongContextConfig) -> Model:
             n_stages = (mesh.shape[AXIS_SHARD]
                         if mesh is not None else 1)
             if mesh is None or n_stages == 1:
-                for i in range(cfg.num_layers):
+                # sequential fallback: apply rows in ORIGINAL layer
+                # order (storage may be device-major-permuted)
+                order = _layer_storage_order()
+                row_of = {l: r for r, l in enumerate(order)}
+                for l in range(cfg.num_layers):
                     x = block_apply(
-                        jax.tree.map(lambda p: p[i], stacked), x)
+                        jax.tree.map(lambda p: p[row_of[l]], stacked), x)
             else:
-                if cfg.num_layers % n_stages:
-                    raise ValueError(
-                        f"pipeline parallelism needs num_layers "
-                        f"({cfg.num_layers}) divisible by the "
-                        f"{n_stages}-stage shard axis")
-                per_stage = cfg.num_layers // n_stages
-
-                def stage_fn(stage_params, x):
-                    # stage_params leaves: [per_stage, ...]
-                    for j in range(per_stage):
-                        x = block_apply(
-                            jax.tree.map(lambda p: p[j], stage_params), x)
-                    return x
-
-                staged = jax.tree.map(
-                    lambda p: p.reshape((n_stages, per_stage)
-                                        + p.shape[1:]), stacked)
+                staged, stage_fn = _stage_pipeline(stacked, n_stages)
                 x = pipeline_apply(stage_fn, staged, x, mesh,
-                                   cfg.num_microbatches)
+                                   cfg.num_microbatches,
+                                   virtual_stages=Vp)
         else:
             for p in params["blocks"]:
                 x = block_apply(p, x)
@@ -248,12 +311,8 @@ def build_model(cfg: LongContextConfig) -> Model:
                 lambda p: loss_fn(p, batch, rng),
                 has_aux=True)(params)
             return loss, metrics, grads
-        if cfg.num_layers % n_stages:
-            raise ValueError(
-                f"pipeline parallelism needs num_layers "
-                f"({cfg.num_layers}) divisible by the "
-                f"{n_stages}-stage shard axis")
-        per_stage = cfg.num_layers // n_stages
+        staged, stage_fn = _stage_pipeline(params["blocks_stacked"],
+                                           n_stages)
 
         labels = jnp.concatenate(
             [ids[:, 1:], jnp.zeros((B, 1), ids.dtype)], axis=1)
@@ -265,14 +324,6 @@ def build_model(cfg: LongContextConfig) -> Model:
             return x + pos[:T].astype(dt)[None]
 
         x, pull_embed = jax.vjp(embed, params["emb"], params["pos"])
-        staged = jax.tree.map(
-            lambda p: p.reshape((n_stages, per_stage) + p.shape[1:]),
-            params["blocks_stacked"])
-
-        def stage_fn(sp, xx):
-            for j in range(per_stage):
-                xx = block_apply(jax.tree.map(lambda p: p[j], sp), xx)
-            return xx
 
         def mb_loss(head, out, y_mb):
             logits = out.astype(jnp.float32) @ head["out_w"]
@@ -288,7 +339,8 @@ def build_model(cfg: LongContextConfig) -> Model:
         loss, (g_stage, g_head, g_x) = pipeline_value_and_grad(
             stage_fn, mb_loss, staged, x, {"labels": labels, "w": w},
             mesh, cfg.num_microbatches,
-            head_params={"out_w": params["out_w"]})
+            head_params={"out_w": params["out_w"]},
+            virtual_stages=Vp)
         g_emb, g_pos = pull_embed(g_x)
         grads = {
             "emb": g_emb, "pos": g_pos, "out_w": g_head["out_w"],

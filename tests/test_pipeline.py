@@ -106,6 +106,102 @@ def test_1f1b_matches_sequential(rng, n_stages, M):
                                rtol=5e-4, atol=5e-6)
 
 
+def _sequential_dm(params, x, S, V):
+    """Sequential reference over device-major-stacked [S*V, ...] params:
+    global stage g lives at row (g % S)*V + g//S."""
+    for g in range(S * V):
+        q = (g % S) * V + g // S
+        x = _stage_fn(jax.tree.map(lambda p: p[q], params), x)
+    return x
+
+
+@pytest.mark.parametrize("S,V,M", [(2, 2, 4), (4, 2, 4), (2, 3, 4),
+                                   (4, 2, 6)])  # 6 % 4: ragged round
+def test_interleaved_matches_sequential(rng, S, V, M):
+    mesh = mesh_lib.build_mesh(num_partitions=S)
+    params = _stacked_params(rng, S * V)
+    B = mesh.shape["repl"] * M * 2
+    x = jnp.asarray(rng.standard_normal((B, D)).astype(np.float32))
+    expected = _sequential_dm(params, x, S, V)
+    got = jax.jit(lambda p, x: pp.pipeline_apply(
+        _stage_fn, p, x, mesh, M, virtual_stages=V))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_interleaved_gradients_match_sequential(rng):
+    S, V, M = 4, 2, 4
+    mesh = mesh_lib.build_mesh(num_partitions=S)
+    params = _stacked_params(rng, S * V)
+    B = mesh.shape["repl"] * M
+    x = jnp.asarray(rng.standard_normal((B, D)).astype(np.float32))
+
+    def pipe_loss(params, x):
+        return jnp.sum(pp.pipeline_apply(_stage_fn, params, x, mesh, M,
+                                         virtual_stages=V) ** 2)
+
+    def seq_loss(params, x):
+        return jnp.sum(_sequential_dm(params, x, S, V) ** 2)
+
+    gp = jax.jit(jax.grad(pipe_loss))(params, x)
+    gs = jax.grad(seq_loss)(params, x)
+    for name in ("w", "b"):
+        # S*V=8-stage tanh chain: float32 summation-order noise is
+        # ~3e-5 abs on O(1) gradients; tolerance covers noise only
+        np.testing.assert_allclose(np.asarray(gp[name]),
+                                   np.asarray(gs[name]), rtol=1e-3,
+                                   atol=1e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("S,V,M", [(2, 2, 4), (4, 2, 4), (2, 3, 6),
+                                   (4, 2, 6)])  # 6 % 4: ragged round
+def test_interleaved_1f1b_matches_sequential(rng, S, V, M):
+    """Interleaved 1F1B fused loss+grads == sequential autodiff."""
+    mesh = mesh_lib.build_mesh(num_partitions=S)
+    params = _stacked_params(rng, S * V)
+    head = {"wout": jnp.asarray(
+        rng.standard_normal((D, D)).astype(np.float32)) * 0.3}
+    B = mesh.shape["repl"] * M
+    x = jnp.asarray(rng.standard_normal((B, D)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((B, D)).astype(np.float32))
+
+    def mb_loss(head, out, y_mb):
+        return jnp.mean((out @ head["wout"] - y_mb) ** 2)
+
+    loss, (g_stage, g_head, g_x) = jax.jit(
+        lambda p, h, x, y: pp.pipeline_value_and_grad(
+            _stage_fn, mb_loss, p, x, y, mesh, M, head_params=h,
+            virtual_stages=V)
+    )(params, head, x, y)
+
+    def seq_loss(params, head, x):
+        out = _sequential_dm(params, x, S, V)
+        return jnp.mean((out @ head["wout"] - y) ** 2)
+
+    eloss, (ep, eh, ex) = jax.value_and_grad(seq_loss, argnums=(0, 1, 2))(
+        params, head, x)
+    np.testing.assert_allclose(float(loss), float(eloss), rtol=2e-5)
+    for name in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g_stage[name]),
+                                   np.asarray(ep[name]), rtol=1e-3,
+                                   atol=1e-4, err_msg=name)
+    np.testing.assert_allclose(np.asarray(g_head["wout"]),
+                               np.asarray(eh["wout"]), rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g_x), np.asarray(ex),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_stage_order_permutation_roundtrip():
+    """Device-major slot q holds global stage (q%V)*S + q//V; the
+    permutation is a bijection and identity when V=1."""
+    assert pp.stage_order_permutation(4, 1) == [0, 1, 2, 3]
+    perm = pp.stage_order_permutation(4, 2)
+    assert sorted(perm) == list(range(8))
+    # device 0's rows (q=0,1) hold stages 0 and 4 — its two chunks
+    assert perm[0] == 0 and perm[1] == 4
+
+
 def test_1f1b_buffer_is_o_s_not_o_m():
     """The in-flight buffer bound is 2S-1 slots, independent of M."""
     assert pp.inflight_buffer_size(num_stages=4, num_microbatches=64) == 7
@@ -115,22 +211,28 @@ def test_1f1b_buffer_is_o_s_not_o_m():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
-def test_pipeline_lm_through_engine(rng, schedule):
-    """'pipeline' mode (both schedules): stages sharded over 'shard',
-    trajectory matches pure data parallelism (same math, pipelined
-    schedule; 1F1B additionally fuses the backward via
-    Model.value_and_grad_fn)."""
+@pytest.mark.parametrize("schedule,virtual", [("gpipe", 1), ("1f1b", 1),
+                                              ("gpipe", 2), ("1f1b", 2)])
+def test_pipeline_lm_through_engine(rng, schedule, virtual):
+    """'pipeline' mode (both schedules, interleaved and not): stages
+    sharded over 'shard', trajectory matches pure data parallelism
+    (same math, pipelined schedule; 1F1B additionally fuses the
+    backward via Model.value_and_grad_fn; virtual=2 interleaves two
+    chunks per device with device-major layer storage)."""
     import parallax_tpu as parallax
     from parallax_tpu.models import long_context as lc
 
     batches = [lc.make_batch(rng, 8, 16, 512) for _ in range(3)]
+    stages = 4 if virtual == 1 else 2
 
     def run(parallelism, num_partitions):
         cfg = lc.tiny_config(num_layers=4, max_len=16)
         cfg.parallelism = parallelism
         cfg.num_microbatches = 2
         cfg.pipeline_schedule = schedule
+        if parallelism == "pipeline" and virtual > 1:
+            cfg.virtual_stages = virtual
+            cfg.pipeline_stages = stages
         sess, *_ = parallax.parallel_run(
             lc.build_model(cfg),
             parallax_config=parallax.Config(run_option="HYBRID",
@@ -141,9 +243,9 @@ def test_pipeline_lm_through_engine(rng, schedule):
         sess.close()
         return losses, state
 
-    pipe_losses, pipe_state = run("pipeline", 4)
+    pipe_losses, pipe_state = run("pipeline", stages)
     data_losses, _ = run("data", 1)
-    # stage params sharded: each device holds 1 of 4 layers
+    # stage params sharded: each device holds num_layers/stages rows
     w = pipe_state.params["blocks_stacked"]["wqkv"]
-    assert w.sharding.shard_shape(w.shape)[0] == 1
+    assert w.sharding.shard_shape(w.shape)[0] == 4 // stages
     np.testing.assert_allclose(pipe_losses, data_losses, rtol=2e-3)
