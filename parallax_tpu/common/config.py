@@ -36,6 +36,16 @@ class PSConfig:
       exchange, and the forward ships unique ids/rows only
       (ops/embedding.py _dedup_capacity). Exact; wire bytes shrink
       whenever duplicates are guaranteed (table rows < per-device ids).
+    * ``dedup_capacity``: optional per-device unique-id slot count for
+      the combine above. The automatic bound min(local ids, vocab+1)
+      can't compress when the vocab is larger than a device's id list
+      even though real batches (Zipf-distributed ids) still carry heavy
+      duplication; declaring a smaller capacity ships only that many
+      ids/rows. NEVER lossy: each lookup counts its distinct ids on
+      device, and any step where some device overflows the declared
+      capacity falls back (a mesh-uniform `lax.cond`) to the exact
+      uncompressed exchange for that lookup — paying the full wire cost
+      for that step instead of dropping updates.
     * ``boundary_among_servers`` / ``boundary_between_workers_and_servers``:
       reference op-placement heuristics that move cheap boundary ops across
       the worker<->ps cut (graph_transform_lib.py:1315-1370). On TPU, op
@@ -47,6 +57,7 @@ class PSConfig:
     protocol: str = "grpc"
     replicate_variables: bool = True
     local_aggregation: bool = True
+    dedup_capacity: Optional[int] = None
     boundary_among_servers: bool = True
     boundary_between_workers_and_servers: bool = True
 

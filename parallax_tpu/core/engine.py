@@ -366,7 +366,9 @@ class Engine:
         model, mesh, config = self.model, self.mesh, self.config
         param_shardings = self._param_shardings
         avg = config.average_sparse
-        local_agg = config.communication_config.ps_config.local_aggregation
+        ps_cfg = config.communication_config.ps_config
+        local_agg = ps_cfg.local_aggregation
+        dedup_cap = ps_cfg.dedup_capacity
         sharded_shapes = self.plan.sharded_shapes
         self._lookup_records: list = []
         lookup_records = self._lookup_records
@@ -402,7 +404,8 @@ class Engine:
                 holder.append(cap)
                 with embedding.sharded_lookup_scope(
                         mesh, sharded_shapes, avg,
-                        local_aggregation=local_agg, slice_capture=cap):
+                        local_aggregation=local_agg,
+                        dedup_capacity=dedup_cap, slice_capture=cap):
                     loss, _, _ = model.call_loss(params, batch, rng,
                                                  mstate)
                 return loss
@@ -511,6 +514,7 @@ class Engine:
                         mesh, sharded_shapes, avg,
                         records=lookup_records,
                         local_aggregation=local_agg,
+                        dedup_capacity=dedup_cap,
                         slice_capture=cap):
                     loss, metrics, new_mstate = model.call_loss(
                         params, batch, step_rng, state.model_state)
@@ -527,7 +531,8 @@ class Engine:
                 with embedding.sharded_lookup_scope(
                         mesh, sharded_shapes, avg,
                         records=lookup_records,
-                        local_aggregation=local_agg):
+                        local_aggregation=local_agg,
+                        dedup_capacity=dedup_cap):
                     loss, metrics, grads = model.value_and_grad_fn(
                         state.params, batch, step_rng)
                 new_mstate, ids_list, gdeltas = None, (), ()
@@ -679,8 +684,14 @@ class Engine:
         return jax.tree.map(lambda x: put("", x), batch)
 
     def sparse_wire_bytes_per_step(self) -> Dict[str, int]:
-        """Exact bytes-on-wire per step for the sparse path vs the dense
-        alternative (the BASELINE.json north-star metric).
+        """Bytes-on-wire per step for the sparse path vs the dense
+        alternative (the BASELINE.json north-star metric). Exact for
+        every configuration except a user-declared
+        ``PSConfig.dedup_capacity`` below the exactness bound, where it
+        is a LOWER bound: steps whose distinct-id count overflows the
+        declared capacity ship the full uncompressed exchange at
+        runtime (the guarded `lax.cond` fallback) while the record
+        counts the declared capacity.
 
         Sparse path: one record per sharded lookup event in the latest
         trace (ops/embedding.py) — forward all_gather(ids, int32) +

@@ -33,6 +33,14 @@ for SPMD: each device segment-sums its duplicate ids into unique slots
 shard axis (stage 2). The static slot capacity min(local ids, vocab+1)
 (the +1 slot absorbs out-of-range sentinels) makes the compression
 exact — see ``_dedup_capacity``.
+
+``dedup_capacity`` (PSConfig knob) declares a smaller slot count for
+workloads the automatic bound can't compress (vocab > per-device ids
+but Zipf-heavy duplication). Never lossy: the lookup counts distinct
+ids at runtime and any step that overflows the declared capacity on any
+device takes a mesh-uniform `lax.cond` fallback to the exact
+uncompressed exchange (full wire cost for that step, no dropped
+updates).
 """
 
 from __future__ import annotations
@@ -110,6 +118,13 @@ class _MeshCtx:
     # U = min(ids, vocab+1) — never fewer slots than possible distinct
     # values (the +1 absorbs out-of-range sentinels).
     local_aggregation: bool = True
+    # User-declared capacity (PSConfig.dedup_capacity) for workloads the
+    # automatic bound can't compress (vocab > per-device ids but batches
+    # Zipf-heavy). Steps where any device's distinct-id count exceeds it
+    # fall back to the exact uncompressed exchange via a mesh-uniform
+    # lax.cond — declared capacity is a wire-size target, never a
+    # correctness risk.
+    dedup_capacity_hint: Optional[int] = None
     # trace-time record of sharded lookups: list of (table_shape,
     # effective ids crossing the wire, count-values crossing the wire),
     # one entry per lookup event in the trace — feeds the exact
@@ -128,13 +143,14 @@ def sharded_lookup_scope(mesh: Mesh, sharded_shapes,
                          average_duplicates: bool = False,
                          records: Optional[list] = None,
                          local_aggregation: bool = True,
-                         slice_capture: Optional[SliceCapture] = None):
+                         slice_capture: Optional[SliceCapture] = None,
+                         dedup_capacity: Optional[int] = None):
     """Engine-installed scope: inside it, ``embedding_lookup`` of a table
     whose shape is registered routes through the sharded collective path."""
     token = _CTX.set(_MeshCtx(mesh, frozenset(tuple(s) for s in
                                               sharded_shapes),
                               average_duplicates, local_aggregation,
-                              records, slice_capture))
+                              dedup_capacity, records, slice_capture))
     try:
         yield
     finally:
@@ -200,29 +216,35 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
         if slice_path is not None:
             rows = ctx.slice_capture.attach(slice_path, ids, rows)
         return rows
-    cap = _dedup_capacity(table.shape, ids.shape, ctx.mesh,
-                          ctx.local_aggregation)
+    cap, guarded = _dedup_capacity(table.shape, ids.shape, ctx.mesh,
+                                   ctx.local_aggregation,
+                                   ctx.dedup_capacity_hint)
     if ctx.records is not None:
         n = num_devices(ctx.mesh)
         n_dev = int(np.prod(ids.shape)) // n
+        # guarded capacities record the declared (compressed) size; an
+        # overflow step pays the raw n_dev cost for that step instead
         n_eff = (cap if cap is not None else n_dev) * n
         # the avg+dedup backward also gathers per-slot occurrence counts
         n_cnt = n_eff if (ctx.average_duplicates and cap is not None) \
             else 0
         ctx.records.append((tuple(table.shape), n_eff, n_cnt))
     if ctx.average_duplicates:
-        rows = _sharded_lookup_avg(table, ids, ctx.mesh, cap)
+        rows = _sharded_lookup_avg(table, ids, ctx.mesh, cap, guarded)
     else:
-        rows = _sharded_lookup(table, ids, ctx.mesh, cap)
+        rows = _sharded_lookup(table, ids, ctx.mesh, cap, guarded)
     if slice_path is not None:
         rows = ctx.slice_capture.attach(slice_path, ids, rows)
     return rows
 
 
 def _dedup_capacity(table_shape, ids_shape, mesh,
-                    local_aggregation: bool) -> Optional[int]:
-    """Static per-device unique-id slot count for the two-stage combine,
-    or None when the combine is off or cannot reduce wire bytes.
+                    local_aggregation: bool,
+                    hint: Optional[int] = None
+                    ) -> Tuple[Optional[int], bool]:
+    """(static per-device unique-id slot count or None, guarded) for the
+    two-stage combine; None when the combine is off or cannot reduce
+    wire bytes.
 
     Exactness needs capacity >= the number of distinct values a device
     can hold. All out-of-range ids (padding sentinels like -1; ids >= V)
@@ -231,12 +253,22 @@ def _dedup_capacity(table_shape, ids_shape, mesh,
     masked path), giving at most vocab+1 distinct values — so the bound
     min(local ids, vocab+1) is never lossy, and a strict win whenever
     the table is smaller than the device's id list (duplicates then
-    guaranteed, e.g. Zipf-heavy batches over a modest vocab)."""
+    guaranteed, e.g. Zipf-heavy batches over a modest vocab).
+
+    A user ``hint`` (PSConfig.dedup_capacity) may set the capacity BELOW
+    that bound — then ``guarded=True`` and the lookup adds a runtime
+    distinct-count check that falls back to the exact uncompressed
+    exchange on overflow (never lossy, see `_sharded_lookup`)."""
     if not local_aggregation:
-        return None
+        return None, False
     n_dev = int(np.prod(ids_shape)) // num_devices(mesh)
-    cap = min(n_dev, int(table_shape[0]) + 1)
-    return cap if cap < n_dev else None
+    bound = min(n_dev, int(table_shape[0]) + 1)
+    if hint is not None:
+        cap = max(1, min(int(hint), bound))
+        if cap >= n_dev:
+            return None, False
+        return cap, cap < bound
+    return (bound, False) if bound < n_dev else (None, False)
 
 
 def _collapse_out_of_range(flat, vocab):
@@ -253,36 +285,83 @@ def _collapse_out_of_range(flat, vocab):
 # --------------------------------------------------------------------------
 
 
-def _sharded_lookup(table, ids, mesh, dedup_capacity: Optional[int] = None):
+def _distinct_count_overflows(flat, vocab, cap):
+    """Mesh-uniform bool: does ANY device's distinct-id count exceed the
+    declared capacity? (psum over both axes so every device — including
+    other replica rows, whose backward shares an AXIS_REPL psum — takes
+    the same `lax.cond` branch)."""
+    s = jnp.sort(_collapse_out_of_range(flat, vocab))
+    n_unique = 1 + jnp.sum((s[1:] != s[:-1]).astype(jnp.int32))
+    over = (n_unique > cap).astype(jnp.int32)
+    over = jax.lax.psum(jax.lax.psum(over, AXIS_SHARD), AXIS_REPL)
+    return over > 0
+
+
+def _overflow_flag(ids, vocab, cap, mesh):
+    """Replicated scalar bool: any device's distinct-id count exceeds
+    the declared capacity (computed ONCE; the avg custom-VJP threads it
+    through its residuals so the backward doesn't re-sort/re-psum)."""
+    def local(ids_local):
+        return _distinct_count_overflows(ids_local.reshape(-1), vocab,
+                                         cap)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=P((AXIS_REPL, AXIS_SHARD)),
+        out_specs=P(),
+    )(ids)
+
+
+def _sharded_lookup(table, ids, mesh, dedup_capacity: Optional[int] = None,
+                    guarded: bool = False, over=None):
     p = mesh.shape[AXIS_SHARD]
     V, D = table.shape
     assert V % p == 0, (
         f"vocab {V} not divisible by shard axis {p}; use pad_vocab()")
     rows_per_shard = V // p
     ids_shape = ids.shape
+    if guarded and over is None:
+        over = _overflow_flag(ids, V, dedup_capacity, mesh)
 
-    def local(table_shard, ids_local):
+    def local(table_shard, ids_local, over_local):
         # table_shard: [V/p, D]; ids_local: [B/(r·p), ...]
         flat = ids_local.reshape(-1)
-        if dedup_capacity is not None:
+
+        def exchange(fl):
+            ids_all = jax.lax.all_gather(fl, AXIS_SHARD, tiled=True)
+            rows = _masked_local_gather(table_shard, ids_all,
+                                        rows_per_shard)
+            return jax.lax.psum_scatter(rows, AXIS_SHARD,
+                                        scatter_dimension=0, tiled=True)
+
+        def raw(_):
+            return exchange(flat)
+
+        def dedup(_):
             # stage 1: per-device unique compression (sentinel id V is
             # owned by no shard, so those slots contribute zero rows)
-            flat, inv = jnp.unique(_collapse_out_of_range(flat, V),
-                                   size=dedup_capacity,
-                                   fill_value=V, return_inverse=True)
-        ids_all = jax.lax.all_gather(flat, AXIS_SHARD, tiled=True)
-        rows = _masked_local_gather(table_shard, ids_all, rows_per_shard)
-        out = jax.lax.psum_scatter(rows, AXIS_SHARD, scatter_dimension=0,
-                                   tiled=True)
-        if dedup_capacity is not None:
-            out = jnp.take(out, inv.reshape(-1), axis=0)
+            fl, inv = jnp.unique(_collapse_out_of_range(flat, V),
+                                 size=dedup_capacity,
+                                 fill_value=V, return_inverse=True)
+            out_u = exchange(fl)
+            return jnp.take(out_u, inv.reshape(-1), axis=0)
+
+        if dedup_capacity is None:
+            out = raw(None)
+        elif guarded:
+            # user-declared capacity below the exactness bound: overflow
+            # steps take the exact raw exchange instead of dropping ids
+            out = jax.lax.cond(over_local, raw, dedup, None)
+        else:
+            out = dedup(None)
         return out.reshape(ids_local.shape + (D,))
 
+    if over is None:
+        over = jnp.zeros((), jnp.bool_)  # unused placeholder
     return jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(AXIS_SHARD, None), P((AXIS_REPL, AXIS_SHARD))),
+        in_specs=(P(AXIS_SHARD, None), P((AXIS_REPL, AXIS_SHARD)), P()),
         out_specs=P((AXIS_REPL, AXIS_SHARD)),
-    )(table, ids.reshape(ids_shape))
+    )(table, ids.reshape(ids_shape), over)
 
 
 def _masked_local_gather(table_shard, ids_all, rows_per_shard):
@@ -301,26 +380,64 @@ def _masked_local_gather(table_shard, ids_all, rows_per_shard):
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def _sharded_lookup_avg_impl(table, ids, mesh, dedup_capacity):
-    return _sharded_lookup(table, ids, mesh, dedup_capacity)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _sharded_lookup_avg_impl(table, ids, mesh, dedup_capacity, guarded):
+    return _sharded_lookup(table, ids, mesh, dedup_capacity, guarded)
 
 
-def _avg_fwd(table, ids, mesh, dedup_capacity):
-    return _sharded_lookup(table, ids, mesh, dedup_capacity), (table.shape,
-                                                               ids)
+def _avg_fwd(table, ids, mesh, dedup_capacity, guarded):
+    # compute the overflow decision ONCE and thread it through the
+    # residuals so the backward reuses it (no re-sort / re-psum)
+    over = (_overflow_flag(ids, table.shape[0], dedup_capacity, mesh)
+            if guarded else jnp.zeros((), jnp.bool_))
+    out = _sharded_lookup(table, ids, mesh, dedup_capacity, guarded,
+                          over=over)
+    return out, (table.shape, ids, over)
 
 
-def _avg_bwd(mesh, dedup_capacity, res, g):
-    (V, D), ids = res
+def _avg_bwd(mesh, dedup_capacity, guarded, res, g):
+    (V, D), ids, over = res
     p = mesh.shape[AXIS_SHARD]
     rows_per_shard = V // p
 
-    def local(g_local, ids_local):
+    def local(g_local, ids_local, over_local):
         # g_local: [B/(r·p), ..., D]; ids_local: [B/(r·p), ...]
         g_flat = g_local.reshape(-1, D)
         ids_flat = ids_local.reshape(-1)
-        if dedup_capacity is not None:
+
+        def combine(ids_x, g_x, cnt_x):
+            # cnt_x None => raw path: one occurrence per position, no
+            # count wire cost
+            g_all = jax.lax.all_gather(g_x, AXIS_SHARD, tiled=True)
+            ids_all = jax.lax.all_gather(ids_x, AXIS_SHARD, tiled=True)
+            cnt_all = (jax.lax.all_gather(cnt_x, AXIS_SHARD, tiled=True)
+                       if cnt_x is not None else None)
+            lo = jax.lax.axis_index(AXIS_SHARD) * rows_per_shard
+            local_idx = ids_all - lo
+            valid = (local_idx >= 0) & (local_idx < rows_per_shard)
+            safe = jnp.where(valid, local_idx, 0)
+            contrib = jnp.zeros((rows_per_shard, D), g_all.dtype)
+            contrib = contrib.at[safe].add(
+                jnp.where(valid[:, None], g_all, jnp.zeros_like(g_all)))
+            counts = jnp.zeros((rows_per_shard,), jnp.float32)
+            if cnt_all is None:
+                counts = counts.at[safe].add(valid.astype(jnp.float32))
+            else:
+                counts = counts.at[safe].add(
+                    jnp.where(valid, cnt_all, jnp.zeros_like(cnt_all)))
+            # Merge replica groups *before* dividing: the counter counts
+            # every contribution in the global batch (reference
+            # accumulates across all workers, then averages once).
+            contrib = jax.lax.psum(contrib, AXIS_REPL)
+            counts = jax.lax.psum(counts, AXIS_REPL)
+            scale = jnp.where(counts > 0,
+                              1.0 / jnp.maximum(counts, 1.0), 0.0)
+            return contrib * scale[:, None].astype(contrib.dtype)
+
+        def raw(_):
+            return combine(ids_flat, g_flat, None)
+
+        def dedup(_):
             # stage 1: segment-sum duplicate row grads (and occurrence
             # counts — SPARSE_AVERAGE_BY_COUNTER averages by occurrence,
             # not by unique id) before anything crosses the wire
@@ -331,38 +448,22 @@ def _avg_bwd(mesh, dedup_capacity, res, g):
                             ).at[inv.reshape(-1)].add(g_flat)
             cnt_x = jnp.zeros((dedup_capacity,), jnp.float32
                               ).at[inv.reshape(-1)].add(1.0)
-            cnt_all = jax.lax.all_gather(cnt_x, AXIS_SHARD, tiled=True)
-        else:
-            ids_x, g_x, cnt_all = ids_flat, g_flat, None
-        g_all = jax.lax.all_gather(g_x, AXIS_SHARD, tiled=True)
-        ids_all = jax.lax.all_gather(ids_x, AXIS_SHARD, tiled=True)
-        lo = jax.lax.axis_index(AXIS_SHARD) * rows_per_shard
-        local_idx = ids_all - lo
-        valid = (local_idx >= 0) & (local_idx < rows_per_shard)
-        safe = jnp.where(valid, local_idx, 0)
-        contrib = jnp.zeros((rows_per_shard, D), g_all.dtype)
-        contrib = contrib.at[safe].add(
-            jnp.where(valid[:, None], g_all, jnp.zeros_like(g_all)))
-        counts = jnp.zeros((rows_per_shard,), jnp.float32)
-        if cnt_all is None:
-            # raw path: one occurrence per position, no count wire cost
-            counts = counts.at[safe].add(valid.astype(jnp.float32))
-        else:
-            counts = counts.at[safe].add(
-                jnp.where(valid, cnt_all, jnp.zeros_like(cnt_all)))
-        # Merge replica groups *before* dividing: the counter counts every
-        # contribution in the global batch (reference accumulates across all
-        # workers, then averages once).
-        contrib = jax.lax.psum(contrib, AXIS_REPL)
-        counts = jax.lax.psum(counts, AXIS_REPL)
-        scale = jnp.where(counts > 0, 1.0 / jnp.maximum(counts, 1.0), 0.0)
-        return (contrib * scale[:, None].astype(contrib.dtype))
+            return combine(ids_x, g_x, cnt_x)
+
+        if dedup_capacity is None:
+            return raw(None)
+        if guarded:
+            # the forward's decision, from the residuals: overflow steps
+            # take the exact uncompressed combine
+            return jax.lax.cond(over_local, raw, dedup, None)
+        return dedup(None)
 
     grad_table = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P((AXIS_REPL, AXIS_SHARD)), P((AXIS_REPL, AXIS_SHARD))),
+        in_specs=(P((AXIS_REPL, AXIS_SHARD)), P((AXIS_REPL, AXIS_SHARD)),
+                  P()),
         out_specs=P(AXIS_SHARD, None),
-    )(g, ids)
+    )(g, ids, over)
     ids_ct = np.zeros(ids.shape, dtype=jax.dtypes.float0)
     return (grad_table, ids_ct)
 
@@ -370,5 +471,7 @@ def _avg_bwd(mesh, dedup_capacity, res, g):
 _sharded_lookup_avg_impl.defvjp(_avg_fwd, _avg_bwd)
 
 
-def _sharded_lookup_avg(table, ids, mesh, dedup_capacity=None):
-    return _sharded_lookup_avg_impl(table, ids, mesh, dedup_capacity)
+def _sharded_lookup_avg(table, ids, mesh, dedup_capacity=None,
+                        guarded=False):
+    return _sharded_lookup_avg_impl(table, ids, mesh, dedup_capacity,
+                                    guarded)

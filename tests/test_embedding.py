@@ -227,6 +227,86 @@ class TestLocalAggregationDedup:
         assert n_eff == B
 
 
+class TestDeclaredDedupCapacity:
+    """PSConfig.dedup_capacity: user-declared slot count below the
+    automatic exactness bound. Compresses Zipf batches the automatic
+    bound cannot (vocab > per-device ids); overflow steps fall back to
+    the exact uncompressed exchange — capacity is a wire-size target,
+    never a correctness risk."""
+
+    CV, CD, CB = 64, 4, 128  # vocab 64 > per-device ids 16 on the 8-mesh
+
+    def _scope(self, avg, cap, records=None):
+        mesh = mesh_lib.build_mesh(num_partitions=4)
+        return embedding.sharded_lookup_scope(
+            mesh, [(self.CV, self.CD)], avg, records=records,
+            local_aggregation=True, dedup_capacity=cap)
+
+    def _run(self, table, ids, g_rows, avg, cap):
+        with self._scope(avg, cap):
+            def loss(t):
+                return jnp.sum(
+                    embedding.embedding_lookup(t, ids) * g_rows)
+            out = jax.jit(
+                lambda t: embedding.embedding_lookup(t, ids))(table)
+            grad = jax.jit(jax.grad(loss))(table)
+        return np.asarray(out), np.asarray(grad)
+
+    @pytest.mark.parametrize("avg", [False, True])
+    def test_exact_under_and_over_capacity(self, rng, avg):
+        table = jnp.asarray(
+            rng.standard_normal((self.CV, self.CD)).astype(np.float32))
+        g_rows = jnp.asarray(rng.standard_normal(
+            (self.CB, self.CD)).astype(np.float32))
+        # Zipf batch: few distinct ids per device -> capacity 8 holds
+        zipf = jnp.asarray(np.minimum(rng.zipf(1.8, size=(self.CB,)) - 1,
+                                      self.CV - 1), dtype=jnp.int32)
+        # adversarial batch: every device sees 16 distinct ids -> the
+        # declared capacity 8 overflows and the exact fallback engages
+        spread = jnp.asarray(np.arange(self.CB) % self.CV,
+                             dtype=jnp.int32)
+        for ids in (zipf, spread):
+            ref_out, ref_grad = self._run(table, ids, g_rows, avg, None)
+            got_out, got_grad = self._run(table, ids, g_rows, avg, 8)
+            np.testing.assert_allclose(got_out, ref_out, rtol=1e-5)
+            np.testing.assert_allclose(got_grad, ref_grad, rtol=1e-4,
+                                       atol=1e-6)
+
+    def test_declared_capacity_cuts_recorded_wire_bytes(self, rng):
+        table = jnp.asarray(
+            rng.standard_normal((self.CV, self.CD)).astype(np.float32))
+        ids = jnp.asarray(np.minimum(rng.zipf(1.8, size=(self.CB,)) - 1,
+                                     self.CV - 1), dtype=jnp.int32)
+        counts = {}
+        for cap in (None, 8):
+            records = []
+            with self._scope(False, cap, records=records):
+                jax.jit(lambda t:
+                        embedding.embedding_lookup(t, ids))(table)
+            (_, n_eff, _), = records
+            counts[cap] = n_eff
+        # automatic bound min(16, 65) = 16 = per-device ids: no win
+        assert counts[None] == self.CB
+        assert counts[8] == 8 * 8  # declared capacity x 8 devices
+        assert counts[8] < counts[None]
+
+    def test_capacity_at_or_above_bound_unguarded(self):
+        """Hints at/above the automatic bound degrade gracefully."""
+        mesh = mesh_lib.build_mesh(num_partitions=4)
+        # vocab 8, local ids 16: auto bound 9; hint 32 clamps to 9
+        cap, guarded = embedding._dedup_capacity(
+            (8, 4), (128,), mesh, True, hint=32)
+        assert (cap, guarded) == (9, False)
+        # hint below the bound: guarded
+        cap, guarded = embedding._dedup_capacity(
+            (8, 4), (128,), mesh, True, hint=4)
+        assert (cap, guarded) == (4, True)
+        # hint >= local ids on a big vocab: no compression possible
+        cap, guarded = embedding._dedup_capacity(
+            (64, 4), (128,), mesh, True, hint=16)
+        assert (cap, guarded) == (None, False)
+
+
 def test_p1_degenerates_to_plain_take(table, ids):
     mesh, scope = _ctx(1)
     with scope:

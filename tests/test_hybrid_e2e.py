@@ -180,6 +180,47 @@ def test_local_aggregation_wire_bytes_and_parity(rng):
     np.testing.assert_allclose(emb_agg, emb_raw, rtol=1e-4, atol=1e-6)
 
 
+def test_dedup_capacity_knob_through_engine(rng):
+    """PSConfig.dedup_capacity plumbs into the lookup: accounted wire
+    bytes shrink to the declared capacity on a big-vocab Zipf batch the
+    automatic bound can't compress, numerics unchanged."""
+    big_v = 512  # vocab > per-device ids (B*8/8 = 16): auto bound no-op
+    ids = np.minimum(rng.zipf(1.8, size=(B * 8,)) - 1,
+                     big_v - 1).astype(np.int32)
+    batch = {"ids": ids, "y": rng.standard_normal(
+        (B * 8, H)).astype(np.float32)}
+
+    def init_fn(rng_):
+        r1, r2 = jax.random.split(rng_)
+        return {"emb": jax.random.normal(r1, (big_v, D)) * 0.1,
+                "proj": {"w": jax.random.normal(r2, (D, H)) * 0.1}}
+
+    def loss_fn(params, b):
+        rows = emb_ops.embedding_lookup(params["emb"], b["ids"])
+        return jnp.mean((rows @ params["proj"]["w"] - b["y"]) ** 2)
+
+    def run_once(cap):
+        model = parallax.Model(init_fn, loss_fn,
+                               optimizer=optax.sgd(0.1),
+                               sparse_params=("emb",))
+        cfg = parallax.Config(run_option="HYBRID",
+                              search_partitions=False)
+        cfg.communication_config.ps_config.dedup_capacity = cap
+        sess, *_ = parallax.parallel_run(model, parallax_config=cfg)
+        loss = sess.run("loss", feed_dict=batch)
+        bytes_ = sess.engine.sparse_wire_bytes_per_step()
+        emb = np.asarray(sess.state.params["emb"])
+        sess.close()
+        return loss, bytes_, emb
+
+    loss_auto, bytes_auto, emb_auto = run_once(None)
+    loss_cap, bytes_cap, emb_cap = run_once(8)
+    assert bytes_cap["sparse_path_bytes"] < \
+        bytes_auto["sparse_path_bytes"]
+    np.testing.assert_allclose(loss_cap, loss_auto, rtol=1e-5)
+    np.testing.assert_allclose(emb_cap, emb_auto, rtol=1e-4, atol=1e-6)
+
+
 def test_sync_false_staleness_k(rng):
     """Config(staleness=k) applies gradients k steps late: the first k
     steps apply zeros, then step t applies g(params at t-k)."""
