@@ -46,6 +46,15 @@ class PSConfig:
       capacity falls back (a mesh-uniform `lax.cond`) to the exact
       uncompressed exchange for that lookup — paying the full wire cost
       for that step instead of dropping updates.
+    * ``cross_replica_sparse``: how row-sharded tables' gradients merge
+      across the 'repl' mesh axis (the axis that crosses slices/DCN
+      under the slice-aware mesh, core/mesh.py). None (default) picks
+      per lookup by a static bytes model: a dense [rows/shard, dim]
+      psum vs gathering only the deduped (ids, row-grads) over the
+      whole mesh — the SPMD form of the reference shipping only
+      aggregated (ids, values) over the slow network
+      (graph_transform_lib.py:1372-1556). True/False forces the choice.
+      Irrelevant when the mesh has a single 'repl' row.
     * ``boundary_among_servers`` / ``boundary_between_workers_and_servers``:
       reference op-placement heuristics that move cheap boundary ops across
       the worker<->ps cut (graph_transform_lib.py:1315-1370). On TPU, op
@@ -58,6 +67,7 @@ class PSConfig:
     replicate_variables: bool = True
     local_aggregation: bool = True
     dedup_capacity: Optional[int] = None
+    cross_replica_sparse: Optional[bool] = None
     boundary_among_servers: bool = True
     boundary_between_workers_and_servers: bool = True
 

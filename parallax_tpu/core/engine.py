@@ -369,6 +369,7 @@ class Engine:
         ps_cfg = config.communication_config.ps_config
         local_agg = ps_cfg.local_aggregation
         dedup_cap = ps_cfg.dedup_capacity
+        xrepl_sparse = ps_cfg.cross_replica_sparse
         sharded_shapes = self.plan.sharded_shapes
         self._lookup_records: list = []
         lookup_records = self._lookup_records
@@ -405,7 +406,9 @@ class Engine:
                 with embedding.sharded_lookup_scope(
                         mesh, sharded_shapes, avg,
                         local_aggregation=local_agg,
-                        dedup_capacity=dedup_cap, slice_capture=cap):
+                        dedup_capacity=dedup_cap,
+                        cross_replica_sparse=xrepl_sparse,
+                        slice_capture=cap):
                     loss, _, _ = model.call_loss(params, batch, rng,
                                                  mstate)
                 return loss
@@ -515,6 +518,7 @@ class Engine:
                         records=lookup_records,
                         local_aggregation=local_agg,
                         dedup_capacity=dedup_cap,
+                        cross_replica_sparse=xrepl_sparse,
                         slice_capture=cap):
                     loss, metrics, new_mstate = model.call_loss(
                         params, batch, step_rng, state.model_state)
@@ -532,7 +536,8 @@ class Engine:
                         mesh, sharded_shapes, avg,
                         records=lookup_records,
                         local_aggregation=local_agg,
-                        dedup_capacity=dedup_cap):
+                        dedup_capacity=dedup_cap,
+                        cross_replica_sparse=xrepl_sparse):
                     loss, metrics, grads = model.value_and_grad_fn(
                         state.params, batch, step_rng)
                 new_mstate, ids_list, gdeltas = None, (), ()
@@ -698,15 +703,20 @@ class Engine:
         psum_scatter(rows), backward all_gather(row grads), O(ids · dim)
         each; with local_aggregation the recorded id count is the
         post-combine unique capacity, so the two-stage win shows up here
-        directly. Dense alternative: ring all-reduce of every row-sharded
-        variable's full gradient (~2 bytes moved per gradient byte),
-        counted per *variable* from the plan so same-shaped tables don't
-        collapse. Call after the first step has compiled.
+        directly. Each record also carries the mesh-total cross-replica
+        combine bytes (dense [rows/shard, dim] psum over 'repl' or the
+        sparse full-mesh gather's extra rows — whichever the static
+        chooser picked; zero on single-repl meshes). Dense alternative:
+        ring all-reduce of every row-sharded variable's full gradient
+        (~2 bytes moved per gradient byte), counted per *variable* from
+        the plan so same-shaped tables don't collapse. Call after the
+        first step has compiled.
         """
         sparse_bytes = 0
-        for tshape, n_ids, n_cnt in self._lookup_records:
+        for tshape, n_ids, n_cnt, repl_bytes in self._lookup_records:
             dim = int(np.prod(tshape[1:])) if len(tshape) > 1 else 1
-            sparse_bytes += n_ids * 4 + 2 * n_ids * dim * 4 + n_cnt * 4
+            sparse_bytes += (n_ids * 4 + 2 * n_ids * dim * 4
+                             + n_cnt * 4 + repl_bytes)
         dense_bytes = 0
         for vs in self.plan.var_specs.values():
             if vs.is_sparse and tuple(vs.shape) in \

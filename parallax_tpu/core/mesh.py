@@ -53,6 +53,17 @@ def row_sharded_spec(ndim: int) -> P:
     return P(AXIS_SHARD, *([None] * (ndim - 1)))
 
 
+def _slice_of(device) -> int:
+    """Connectivity domain of a device: its TPU slice when the runtime
+    exposes one (multi-slice pods link slices over DCN, devices within a
+    slice over ICI), else its host process (multi-host CPU/GPU: intra-
+    process fast, inter-process over the network)."""
+    s = getattr(device, "slice_index", None)
+    if s is not None:
+        return int(s)
+    return int(device.process_index)
+
+
 def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
                num_partitions: Optional[int] = None) -> Mesh:
     """Build the ('repl', 'shard') mesh.
@@ -61,6 +72,14 @@ def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
     reference's fixed_size_partitioner accepts any count because PS tasks can
     hold uneven slices; XLA sharding wants even splits, so we snap to the
     nearest divisor <= requested, logging when we do).
+
+    Devices are ordered so the 'shard' axis nests INSIDE a connectivity
+    domain (TPU slice, else host) whenever the shard count divides the
+    domain size: the shard ring's all_gather/psum_scatter then rides ICI
+    and only the 'repl' axis (dense grad psum / sparse cross-replica
+    combine, ops/embedding.py) crosses DCN — the topology split the
+    reference gets from aggregating machine-locally before touching the
+    network (graph_transform_lib.py:1372-1556).
     """
     if devices is None:
         devices = jax.devices()
@@ -74,8 +93,34 @@ def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
             "num_partitions=%d does not divide device count %d; "
             "snapping to %d", p, n, snapped)
         p = snapped
-    arr = np.asarray(devices).reshape(n // p, p)
-    return Mesh(arr, (AXIS_REPL, AXIS_SHARD))
+    devices = _order_by_domain(devices, p)
+    arr = np.empty((n,), dtype=object)
+    for i, d in enumerate(devices):
+        arr[i] = d
+    return Mesh(arr.reshape(n // p, p), (AXIS_REPL, AXIS_SHARD))
+
+
+def _order_by_domain(devices, p: int):
+    """Order devices so each row of p consecutive ones (a shard ring)
+    stays inside one connectivity domain when the division works out;
+    'repl' then spans domains (DCN)."""
+    domains = {}
+    for d in devices:
+        domains.setdefault(_slice_of(d), []).append(d)
+    if len(domains) <= 1:
+        return list(devices)
+    sizes = {len(v) for v in domains.values()}
+    # rings nest inside domains when every domain splits into whole
+    # rings (sizes may differ); with equal sizes a bigger ring may
+    # still span whole domains, keeping repl rows aligned
+    if all(len(v) % p == 0 for v in domains.values()) or (
+            len(sizes) == 1 and p % next(iter(sizes)) == 0):
+        return [d for k in sorted(domains) for d in domains[k]]
+    parallax_log.warning(
+        "shard axis %d does not nest in the connectivity domains "
+        "(sizes %s); shard collectives will cross DCN", p,
+        sorted(len(v) for v in domains.values()))
+    return list(devices)
 
 
 def named(mesh: Mesh, spec: P) -> NamedSharding:

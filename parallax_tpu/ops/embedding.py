@@ -125,6 +125,10 @@ class _MeshCtx:
     # lax.cond — declared capacity is a wire-size target, never a
     # correctness risk.
     dedup_capacity_hint: Optional[int] = None
+    # Cross-replica table-grad combine: None = auto by bytes, True/False
+    # forces sparse (gather deduped rows over the whole mesh) vs dense
+    # ([rows/shard, dim] psum over 'repl') — see _choose_sparse_repl.
+    cross_replica_sparse_hint: Optional[bool] = None
     # trace-time record of sharded lookups: list of (table_shape,
     # effective ids crossing the wire, count-values crossing the wire),
     # one entry per lookup event in the trace — feeds the exact
@@ -144,13 +148,15 @@ def sharded_lookup_scope(mesh: Mesh, sharded_shapes,
                          records: Optional[list] = None,
                          local_aggregation: bool = True,
                          slice_capture: Optional[SliceCapture] = None,
-                         dedup_capacity: Optional[int] = None):
+                         dedup_capacity: Optional[int] = None,
+                         cross_replica_sparse: Optional[bool] = None):
     """Engine-installed scope: inside it, ``embedding_lookup`` of a table
     whose shape is registered routes through the sharded collective path."""
     token = _CTX.set(_MeshCtx(mesh, frozenset(tuple(s) for s in
                                               sharded_shapes),
                               average_duplicates, local_aggregation,
-                              dedup_capacity, records, slice_capture))
+                              dedup_capacity, cross_replica_sparse,
+                              records, slice_capture))
     try:
         yield
     finally:
@@ -219,23 +225,72 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
     cap, guarded = _dedup_capacity(table.shape, ids.shape, ctx.mesh,
                                    ctx.local_aggregation,
                                    ctx.dedup_capacity_hint)
+    n = num_devices(ctx.mesh)
+    n_dev = int(np.prod(ids.shape)) // n
+    cap_eff = cap if cap is not None else n_dev
+    # occurrence counts cross the wire only when the dedup stage is
+    # active AND averaging (the raw path derives them locally)
+    has_counts = ctx.average_duplicates and cap is not None
+    sparse_repl = _choose_sparse_repl(
+        ctx.mesh, table.shape, cap_eff, has_counts,
+        ctx.cross_replica_sparse_hint)
     if ctx.records is not None:
-        n = num_devices(ctx.mesh)
-        n_dev = int(np.prod(ids.shape)) // n
         # guarded capacities record the declared (compressed) size; an
         # overflow step pays the raw n_dev cost for that step instead
-        n_eff = (cap if cap is not None else n_dev) * n
-        # the avg+dedup backward also gathers per-slot occurrence counts
-        n_cnt = n_eff if (ctx.average_duplicates and cap is not None) \
-            else 0
-        ctx.records.append((tuple(table.shape), n_eff, n_cnt))
-    if ctx.average_duplicates:
-        rows = _sharded_lookup_avg(table, ids, ctx.mesh, cap, guarded)
+        n_eff = cap_eff * n
+        n_cnt = n_eff if has_counts else 0
+        ctx.records.append((tuple(table.shape), n_eff, n_cnt,
+                            _cross_replica_bytes(
+                                ctx.mesh, table.shape, cap_eff,
+                                has_counts, sparse_repl)))
+    if ctx.average_duplicates or sparse_repl:
+        rows = _sharded_lookup_manual(table, ids, ctx.mesh, cap, guarded,
+                                      ctx.average_duplicates, sparse_repl)
     else:
         rows = _sharded_lookup(table, ids, ctx.mesh, cap, guarded)
     if slice_path is not None:
         rows = ctx.slice_capture.attach(slice_path, ids, rows)
     return rows
+
+
+def _cross_replica_bytes(mesh, table_shape, cap_eff: int, counts: bool,
+                         sparse_repl: bool) -> int:
+    """Mesh-TOTAL bytes the table-grad combine moves ACROSS the 'repl'
+    axis per step (zero when repl == 1; same unit as the mesh-total
+    shard-exchange terms in the engine's accounting). Dense: every
+    device ring-all-reduces its [rows/shard, dim] shard grad. Sparse:
+    every device additionally receives the other (repl-1) rows' deduped
+    ids/grads in the full-mesh gather. ``counts`` adds the occurrence-
+    count plane (shipped only when the dedup stage is active AND
+    averaging — the raw path derives counts locally)."""
+    r = mesh.shape[AXIS_REPL]
+    if r <= 1:
+        return 0
+    p = mesh.shape[AXIS_SHARD]
+    n = r * p
+    V = int(table_shape[0])
+    D = int(np.prod(table_shape[1:])) if len(table_shape) > 1 else 1
+    if sparse_repl:
+        per_slot = D * 4 + 4 + (4 if counts else 0)  # rows + ids (+cnt)
+        return n * (r - 1) * p * cap_eff * per_slot
+    return int(n * 2 * (r - 1) / r * (V // p) * D * 4)
+
+
+def _choose_sparse_repl(mesh, table_shape, cap_eff: int, counts: bool,
+                        hint: Optional[bool]) -> bool:
+    """Static choice of the cross-replica combine: gather only deduped
+    rows over the whole mesh vs dense psum of the shard grad over
+    'repl' (the axis that crosses slices/DCN under the slice-aware
+    mesh). Shapes are static, so the cheaper side is known at trace
+    time — no runtime switch needed."""
+    if mesh.shape[AXIS_REPL] <= 1:
+        return False
+    if hint is not None:
+        return bool(hint)
+    return (_cross_replica_bytes(mesh, table_shape, cap_eff, counts,
+                                 True)
+            < _cross_replica_bytes(mesh, table_shape, cap_eff, counts,
+                                   False))
 
 
 def _dedup_capacity(table_shape, ids_shape, mesh,
@@ -376,16 +431,21 @@ def _masked_local_gather(table_shard, ids_all, rows_per_shard):
 
 
 # --------------------------------------------------------------------------
-# Average-by-counter path (SPARSE_AVERAGE_BY_COUNTER parity): custom VJP.
+# Manual-backward path: custom VJP used when the AD transpose isn't the
+# backward we want — average-by-counter (SPARSE_AVERAGE_BY_COUNTER
+# parity) and/or the sparse cross-replica combine (gathering only the
+# deduped rows over 'repl' instead of a dense [rows/shard, dim] psum).
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def _sharded_lookup_avg_impl(table, ids, mesh, dedup_capacity, guarded):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _sharded_lookup_manual(table, ids, mesh, dedup_capacity, guarded,
+                           average, sparse_repl):
     return _sharded_lookup(table, ids, mesh, dedup_capacity, guarded)
 
 
-def _avg_fwd(table, ids, mesh, dedup_capacity, guarded):
+def _manual_fwd(table, ids, mesh, dedup_capacity, guarded, average,
+                sparse_repl):
     # compute the overflow decision ONCE and thread it through the
     # residuals so the backward reuses it (no re-sort / re-psum)
     over = (_overflow_flag(ids, table.shape[0], dedup_capacity, mesh)
@@ -395,10 +455,14 @@ def _avg_fwd(table, ids, mesh, dedup_capacity, guarded):
     return out, (table.shape, ids, over)
 
 
-def _avg_bwd(mesh, dedup_capacity, guarded, res, g):
+def _manual_bwd(mesh, dedup_capacity, guarded, average, sparse_repl,
+                res, g):
     (V, D), ids, over = res
     p = mesh.shape[AXIS_SHARD]
+    r = mesh.shape[AXIS_REPL]
     rows_per_shard = V // p
+    gather_axes = ((AXIS_REPL, AXIS_SHARD) if sparse_repl and r > 1
+                   else AXIS_SHARD)
 
     def local(g_local, ids_local, over_local):
         # g_local: [B/(r·p), ..., D]; ids_local: [B/(r·p), ...]
@@ -407,10 +471,13 @@ def _avg_bwd(mesh, dedup_capacity, guarded, res, g):
 
         def combine(ids_x, g_x, cnt_x):
             # cnt_x None => raw path: one occurrence per position, no
-            # count wire cost
-            g_all = jax.lax.all_gather(g_x, AXIS_SHARD, tiled=True)
-            ids_all = jax.lax.all_gather(ids_x, AXIS_SHARD, tiled=True)
-            cnt_all = (jax.lax.all_gather(cnt_x, AXIS_SHARD, tiled=True)
+            # count wire cost. With sparse_repl the gather spans the
+            # WHOLE mesh, every device computes the identical global
+            # scatter, and no repl psum is needed (that dense psum is
+            # exactly the DCN traffic this mode exists to avoid).
+            g_all = jax.lax.all_gather(g_x, gather_axes, tiled=True)
+            ids_all = jax.lax.all_gather(ids_x, gather_axes, tiled=True)
+            cnt_all = (jax.lax.all_gather(cnt_x, gather_axes, tiled=True)
                        if cnt_x is not None else None)
             lo = jax.lax.axis_index(AXIS_SHARD) * rows_per_shard
             local_idx = ids_all - lo
@@ -420,16 +487,25 @@ def _avg_bwd(mesh, dedup_capacity, guarded, res, g):
             contrib = contrib.at[safe].add(
                 jnp.where(valid[:, None], g_all, jnp.zeros_like(g_all)))
             counts = jnp.zeros((rows_per_shard,), jnp.float32)
-            if cnt_all is None:
-                counts = counts.at[safe].add(valid.astype(jnp.float32))
-            else:
-                counts = counts.at[safe].add(
-                    jnp.where(valid, cnt_all, jnp.zeros_like(cnt_all)))
-            # Merge replica groups *before* dividing: the counter counts
-            # every contribution in the global batch (reference
-            # accumulates across all workers, then averages once).
-            contrib = jax.lax.psum(contrib, AXIS_REPL)
-            counts = jax.lax.psum(counts, AXIS_REPL)
+            if average:
+                if cnt_all is None:
+                    counts = counts.at[safe].add(
+                        valid.astype(jnp.float32))
+                else:
+                    counts = counts.at[safe].add(
+                        jnp.where(valid, cnt_all,
+                                  jnp.zeros_like(cnt_all)))
+            if gather_axes == AXIS_SHARD:
+                # Merge replica groups *before* dividing: the counter
+                # counts every contribution in the global batch
+                # (reference accumulates across all workers, then
+                # averages once). (Also proves repl-invariance to the
+                # vma checker; free when repl == 1.)
+                contrib = jax.lax.psum(contrib, AXIS_REPL)
+                if average:
+                    counts = jax.lax.psum(counts, AXIS_REPL)
+            if not average:
+                return contrib
             scale = jnp.where(counts > 0,
                               1.0 / jnp.maximum(counts, 1.0), 0.0)
             return contrib * scale[:, None].astype(contrib.dtype)
@@ -446,8 +522,9 @@ def _avg_bwd(mesh, dedup_capacity, guarded, res, g):
                 size=dedup_capacity, fill_value=V, return_inverse=True)
             g_x = jnp.zeros((dedup_capacity, D), g_flat.dtype
                             ).at[inv.reshape(-1)].add(g_flat)
-            cnt_x = jnp.zeros((dedup_capacity,), jnp.float32
-                              ).at[inv.reshape(-1)].add(1.0)
+            cnt_x = (jnp.zeros((dedup_capacity,), jnp.float32
+                               ).at[inv.reshape(-1)].add(1.0)
+                     if average else None)
             return combine(ids_x, g_x, cnt_x)
 
         if dedup_capacity is None:
@@ -458,20 +535,18 @@ def _avg_bwd(mesh, dedup_capacity, guarded, res, g):
             return jax.lax.cond(over_local, raw, dedup, None)
         return dedup(None)
 
+    # sparse_repl output is invariant over 'repl' BY CONSTRUCTION (every
+    # device scatters the same full-mesh gather), which the static vma
+    # checker can't see — hence check_vma=False on that variant only
     grad_table = jax.shard_map(
         local, mesh=mesh,
         in_specs=(P((AXIS_REPL, AXIS_SHARD)), P((AXIS_REPL, AXIS_SHARD)),
                   P()),
         out_specs=P(AXIS_SHARD, None),
+        check_vma=not (sparse_repl and r > 1),
     )(g, ids, over)
     ids_ct = np.zeros(ids.shape, dtype=jax.dtypes.float0)
     return (grad_table, ids_ct)
 
 
-_sharded_lookup_avg_impl.defvjp(_avg_fwd, _avg_bwd)
-
-
-def _sharded_lookup_avg(table, ids, mesh, dedup_capacity=None,
-                        guarded=False):
-    return _sharded_lookup_avg_impl(table, ids, mesh, dedup_capacity,
-                                    guarded)
+_sharded_lookup_manual.defvjp(_manual_fwd, _manual_bwd)

@@ -171,7 +171,7 @@ class TestLocalAggregationDedup:
             with self._scope(4, False, local_agg, records=records):
                 jax.jit(lambda t:
                         embedding.embedding_lookup(t, ids))(table)
-            (_, n_eff, _), = records
+            (_, n_eff, _, _), = records
             counts[local_agg] = n_eff
         assert counts[False] == self.SB
         # capacity min(local ids 16, vocab+1 = 9) = 9 slots x 8 devices
@@ -223,7 +223,7 @@ class TestLocalAggregationDedup:
                                             records=records,
                                             local_aggregation=True):
             jax.jit(lambda t: embedding.embedding_lookup(t, ids))(table)
-        (_, n_eff, _), = records
+        (_, n_eff, _, _), = records
         assert n_eff == B
 
 
@@ -283,7 +283,7 @@ class TestDeclaredDedupCapacity:
             with self._scope(False, cap, records=records):
                 jax.jit(lambda t:
                         embedding.embedding_lookup(t, ids))(table)
-            (_, n_eff, _), = records
+            (_, n_eff, _, _), = records
             counts[cap] = n_eff
         # automatic bound min(16, 65) = 16 = per-device ids: no win
         assert counts[None] == self.CB
@@ -305,6 +305,77 @@ class TestDeclaredDedupCapacity:
         cap, guarded = embedding._dedup_capacity(
             (64, 4), (128,), mesh, True, hint=16)
         assert (cap, guarded) == (None, False)
+
+
+class TestSparseCrossReplicaCombine:
+    """Cross-replica table-grad combine: gathering only the deduped
+    (ids, row-grads) over 'repl' vs the dense [rows/shard, dim] psum —
+    numerics identical either way, chosen statically by bytes."""
+
+    XD, XB = 4, 128  # p=4, r=2 on the 8-device mesh; 16 ids/device
+
+    def _scope(self, vocab, avg, xrepl, records=None):
+        mesh = mesh_lib.build_mesh(num_partitions=4)
+        assert mesh.shape["repl"] == 2
+        return embedding.sharded_lookup_scope(
+            mesh, [(vocab, self.XD)], avg, records=records,
+            local_aggregation=True, cross_replica_sparse=xrepl)
+
+    # vocab 8 < 16 ids/device: the dedup stage engages (compressed
+    # gather + shipped counts); vocab 64: raw full-id gather
+    @pytest.mark.parametrize("vocab", [8, 64])
+    @pytest.mark.parametrize("avg", [False, True])
+    def test_parity_forced_sparse_vs_dense(self, rng, avg, vocab):
+        table = jnp.asarray(
+            rng.standard_normal((vocab, self.XD)).astype(np.float32))
+        ids = jnp.asarray(rng.integers(0, vocab, size=(self.XB,)),
+                          dtype=jnp.int32)
+        g_rows = jnp.asarray(rng.standard_normal(
+            (self.XB, self.XD)).astype(np.float32))
+
+        grads = {}
+        for xrepl in (False, True):
+            with self._scope(vocab, avg, xrepl):
+                def loss(t):
+                    return jnp.sum(
+                        embedding.embedding_lookup(t, ids) * g_rows)
+                grads[xrepl] = np.asarray(jax.jit(jax.grad(loss))(table))
+        np.testing.assert_allclose(grads[True], grads[False],
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_accounting_reflects_choice(self, rng):
+        vocab = 64
+        table = jnp.asarray(
+            rng.standard_normal((vocab, self.XD)).astype(np.float32))
+        ids = jnp.asarray(rng.integers(0, vocab, size=(self.XB,)),
+                          dtype=jnp.int32)
+        repl_bytes = {}
+        for xrepl in (False, True):
+            records = []
+            with self._scope(vocab, False, xrepl, records=records):
+                jax.jit(lambda t:
+                        embedding.embedding_lookup(t, ids))(table)
+            (_, _, _, rb), = records
+            repl_bytes[xrepl] = rb
+        assert repl_bytes[False] > 0  # dense psum cost visible
+        assert repl_bytes[True] > 0
+        assert repl_bytes[True] != repl_bytes[False]
+
+    def test_auto_chooser_by_bytes(self):
+        mesh = mesh_lib.build_mesh(num_partitions=4)
+        # big vocab, few ids: sparse gather beats dense psum
+        assert embedding._choose_sparse_repl(
+            mesh, (1 << 20, 64), cap_eff=128, counts=False, hint=None)
+        # tiny vocab, many ids: dense psum cheaper
+        assert not embedding._choose_sparse_repl(
+            mesh, (16, 4), cap_eff=16, counts=False, hint=None)
+        # single repl row: never
+        mesh1 = mesh_lib.build_mesh(num_partitions=8)
+        assert not embedding._choose_sparse_repl(
+            mesh1, (1 << 20, 64), cap_eff=128, counts=False, hint=None)
+        # hint forces
+        assert embedding._choose_sparse_repl(
+            mesh, (16, 4), cap_eff=16, counts=False, hint=True)
 
 
 def test_p1_degenerates_to_plain_take(table, ids):

@@ -129,3 +129,65 @@ class TestPartitionSearch:
     def test_min_partitions_snapped_to_divisor(self):
         s = PartitionSearch(3, 8)
         assert s.first_candidate() == 2
+
+
+class TestSliceAwareMesh:
+    """build_mesh orders devices so shard rings stay inside one
+    connectivity domain (TPU slice / host) and 'repl' crosses domains
+    (DCN) — the topology split behind the two-stage sparse combine."""
+
+    class FakeDev:
+        def __init__(self, i, slice_index):
+            self.id = i
+            self.slice_index = slice_index
+            self.process_index = 0
+
+        def __repr__(self):
+            return f"d{self.id}s{self.slice_index}"
+
+    def _devs(self, interleaved=True):
+        # 8 devices over 2 slices, enumerated slice-interleaved (worst
+        # case: naive order would put both slices in every shard ring)
+        if interleaved:
+            order = [0, 1, 0, 1, 0, 1, 0, 1]
+        else:
+            order = [0, 0, 0, 0, 1, 1, 1, 1]
+        return [self.FakeDev(i, s) for i, s in enumerate(order)]
+
+    def test_shard_ring_nests_in_slice(self):
+        from parallax_tpu.core.mesh import _order_by_domain
+        devs = self._devs(interleaved=True)
+        ordered = _order_by_domain(devs, p=4)
+        rows = [ordered[0:4], ordered[4:8]]
+        for row in rows:
+            assert len({d.slice_index for d in row}) == 1
+
+    def test_non_nesting_shard_count_warns_keeps_order(self):
+        from parallax_tpu.core.mesh import _order_by_domain
+        # 8 devices over 2 slices of 4; p=8 spans both (8 % 4 == 0 ->
+        # still grouped so repl rows align); p=3 can't nest at all
+        devs = self._devs(interleaved=True)
+        assert len(_order_by_domain(devs, p=8)) == 8
+        ordered = _order_by_domain(devs, p=3)
+        assert [d.id for d in ordered] == list(range(8))
+
+    def test_single_domain_untouched(self):
+        from parallax_tpu.core.mesh import _order_by_domain
+        devs = self._devs(interleaved=False)
+        for d in devs:
+            d.slice_index = 0
+        ordered = _order_by_domain(devs, p=4)
+        assert [d.id for d in ordered] == list(range(8))
+
+    def test_unequal_domains_still_nest_when_divisible(self):
+        from parallax_tpu.core.mesh import _order_by_domain
+        # 12 devices over slices of 8 and 4; p=4 splits both into whole
+        # rings -> grouped despite unequal sizes
+        devs = ([self.FakeDev(i, 0) for i in range(8)]
+                + [self.FakeDev(8 + i, 1) for i in range(4)])
+        import random
+        random.Random(0).shuffle(devs)
+        ordered = _order_by_domain(devs, p=4)
+        for row in range(3):
+            ring = ordered[row * 4:(row + 1) * 4]
+            assert len({d.slice_index for d in ring}) == 1
