@@ -26,6 +26,11 @@ PARALLAX_RESOURCE_INFO = "PARALLAX_RESOURCE_INFO"
 # --- JAX multi-host coordination (new; replaces ssh/mpirun plumbing) -------
 PARALLAX_COORDINATOR_ADDRESS = "PARALLAX_COORDINATOR_ADDRESS"
 PARALLAX_COORDINATOR_PORT_DEFAULT = 8476
+# Elastic recovery (new; the reference master neither detected worker
+# death nor recovered — SURVEY.md §5.3): full-cluster relaunch from the
+# last checkpoint, at most this many times.
+PARALLAX_MAX_RESTARTS = "PARALLAX_MAX_RESTARTS"
+PARALLAX_RESTART_ATTEMPT = "PARALLAX_RESTART_ATTEMPT"  # set on workers
 
 # --- partition auto-search (reference consts.py + partitions.py:29-31) -----
 # Search state lives in the session (in-place re-jit), so the reference's

@@ -178,9 +178,13 @@ def _shell_quote(s: str) -> str:
 # --------------------------------------------------------------------------
 
 
-def open_redirect_files(redirect_path: str, job: str, task: int):
-    """Create per-process log files log_{job}{task}_{stdout,stderr}."""
+def open_redirect_files(redirect_path: str, job: str, task: int,
+                        attempt: int = 0):
+    """Create per-process log files log_{job}{task}_{stdout,stderr};
+    elastic-restart attempts get their own files (suffix _attempt{k})
+    so the crashed attempt's logs — the diagnostics of the failure
+    being recovered from — survive the relaunch."""
     os.makedirs(redirect_path, exist_ok=True)
-    out = open(os.path.join(redirect_path, f"log_{job}{task}_stdout"), "w")
-    err = open(os.path.join(redirect_path, f"log_{job}{task}_stderr"), "w")
-    return out, err
+    suffix = f"_attempt{attempt}" if attempt else ""
+    base = os.path.join(redirect_path, f"log_{job}{task}{suffix}")
+    return open(f"{base}_stdout", "w"), open(f"{base}_stderr", "w")

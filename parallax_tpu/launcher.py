@@ -27,18 +27,87 @@ from parallax_tpu.common.lib import (HostInfo, _shell_quote, parallax_log,
 
 
 def launch_workers(hosts: Sequence[HostInfo],
-                   redirect_path: str | None = None) -> int:
-    """Spawn the current script on every host; wait on the chief; SIGINT the
-    rest on exit (reference runner.py:124-136 cleanup semantics).
+                   redirect_path: str | None = None,
+                   max_restarts: int | None = None,
+                   has_checkpoint: bool = False) -> int:
+    """Spawn the current script on every host; wait on the chief; SIGINT
+    the rest on exit (reference runner.py:124-136 cleanup semantics).
 
-    Returns the chief's exit code.
+    Elastic recovery (beyond the reference, SURVEY.md §5.3): when any
+    worker dies and ``max_restarts`` (or env PARALLAX_MAX_RESTARTS) is
+    positive, the surviving processes are torn down — remote ones
+    killed through their pid file, see `_remote_kill` — and the WHOLE
+    cluster is relaunched; synchronous SPMD can't continue around a
+    dead member, so the recovery unit is the cluster. With
+    ``has_checkpoint`` (ckpt_dir configured) training resumes from the
+    last checkpoint via the session's implicit restore (checkpoint.py);
+    without it the relaunch retrains from step 0 and the log says so.
+    Each attempt bumps the coordinator port so a half-dead coordinator
+    socket can't wedge the relaunch, and writes separate redirect logs
+    so the crashed attempt's diagnostics survive.
+
+    Returns the final attempt's exit code.
     """
-    port = os.environ.get("PARALLAX_COORDINATOR_PORT",
-                          consts.PARALLAX_COORDINATOR_PORT_DEFAULT)
-    coordinator = f"{hosts[0].hostname}:{port}"
+    if max_restarts is None:
+        max_restarts = int(os.environ.get(consts.PARALLAX_MAX_RESTARTS,
+                                          "0"))
+    attempt = 0
+    while True:
+        rc = _run_cluster_once(hosts, redirect_path, attempt)
+        if rc == 0 or rc == 130:      # success, or user interrupt
+            return rc
+        if attempt >= max_restarts:
+            if max_restarts:
+                parallax_log.error(
+                    "cluster failed (rc=%d) after %d restart(s); "
+                    "giving up", rc, attempt)
+            return rc
+        attempt += 1
+        parallax_log.warning(
+            "cluster failed (rc=%d); elastic restart %d/%d — %s",
+            rc, attempt, max_restarts,
+            "workers will resume from the last checkpoint"
+            if has_checkpoint else
+            "NO ckpt_dir is configured, so training restarts from "
+            "step 0 (set CheckPointConfig.ckpt_dir to make restarts "
+            "resume)")
+
+
+def _is_local(hostname: str) -> bool:
+    return hostname in ("localhost", "127.0.0.1")
+
+
+def _remote_kill(hostname: str, pidfile: str) -> None:
+    """Kill the remote worker behind ``pidfile`` (INT, then KILL).
+
+    SIGINT on the local ssh client only kills the client — the remote
+    python would keep running and a relaunch would double-write the
+    checkpoint dir. The worker's pid was recorded at spawn (`echo $$`
+    before `exec`), so this reaches the real process."""
+    import subprocess
+    kill_cmd = (f"if [ -f {pidfile} ]; then "
+                f"kill -INT $(cat {pidfile}) 2>/dev/null; sleep 5; "
+                f"kill -9 $(cat {pidfile}) 2>/dev/null; "
+                f"rm -f {pidfile}; fi")
+    try:
+        subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no",
+                        hostname, kill_cmd], timeout=30,
+                       capture_output=True)
+    except Exception as e:  # kill is best-effort; log and move on
+        parallax_log.warning("remote kill on %s failed: %s", hostname, e)
+
+
+def _run_cluster_once(hosts: Sequence[HostInfo],
+                      redirect_path: str | None,
+                      attempt: int) -> int:
+    port = int(os.environ.get("PARALLAX_COORDINATOR_PORT",
+                              consts.PARALLAX_COORDINATOR_PORT_DEFAULT))
+    coordinator = f"{hosts[0].hostname}:{port + attempt}"
     serialized = serialize_resource_info(hosts)
     cmd = (_shell_quote(sys.executable) + " "
            + " ".join(_shell_quote(a) for a in sys.argv))
+    tag = f"{os.getpid()}_{attempt}"
+    pidfiles = {}             # machine_id -> remote pid file
     procs: List = []          # (machine_id, Popen)
     # Reverse order, chief last (reference ps/runner.py:163-193: the chief
     # must come up after its peers are listening).
@@ -51,6 +120,7 @@ def launch_workers(hosts: Sequence[HostInfo],
             consts.PARALLAX_HOSTNAME: host.hostname,
             consts.PARALLAX_RESOURCE_INFO: serialized,
             consts.PARALLAX_COORDINATOR_ADDRESS: coordinator,
+            consts.PARALLAX_RESTART_ATTEMPT: attempt,
         }
         for var in (consts.PARALLAX_MIN_PARTITIONS,
                     consts.PARALLAX_PARTITIONS, consts.PARALLAX_LOG_LEVEL):
@@ -60,11 +130,20 @@ def launch_workers(hosts: Sequence[HostInfo],
         if redirect_path:
             from parallax_tpu.common.lib import open_redirect_files
             stdout, stderr = open_redirect_files(redirect_path, "worker",
-                                                 machine_id)
+                                                 machine_id,
+                                                 attempt=attempt)
         parallax_log.info("launching worker %d on %s", machine_id,
                           host.hostname)
+        host_cmd = cmd
+        if not _is_local(host.hostname):
+            # record the worker's pid remotely so teardown can kill the
+            # PROCESS, not just the local ssh client (exec makes the
+            # python process own the recorded pid)
+            pidfile = f"/tmp/parallax_{tag}_{machine_id}.pid"
+            pidfiles[machine_id] = pidfile
+            host_cmd = f"echo $$ > {pidfile}; exec {cmd}"
         procs.append((machine_id,
-                      remote_exec(cmd, host.hostname, env=env,
+                      remote_exec(host_cmd, host.hostname, env=env,
                                   stdout=stdout, stderr=stderr)))
     chief = procs[-1][1]
     try:
@@ -91,12 +170,15 @@ def launch_workers(hosts: Sequence[HostInfo],
     except KeyboardInterrupt:
         rc = 130
     finally:
-        for _, p in procs:
+        for machine_id, p in procs:
             if p.poll() is None:
                 try:
                     p.send_signal(signal.SIGINT)
                 except OSError:
                     pass
+                if machine_id in pidfiles:
+                    _remote_kill(hosts[machine_id].hostname,
+                                 pidfiles[machine_id])
         for _, p in procs:
             try:
                 p.wait(timeout=30)

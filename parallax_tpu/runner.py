@@ -65,7 +65,9 @@ def parallel_run(model: Model,
         if len(hosts) > 1:
             # Master path: spawn one process per host and exit, exactly like
             # the reference master (runner.py:187 sys.exit()).
-            rc = launcher.launch_workers(hosts, config.redirect_path)
+            rc = launcher.launch_workers(
+                hosts, config.redirect_path,
+                has_checkpoint=config.ckpt_config.ckpt_dir is not None)
             sys.exit(rc)
 
     unused = config.unused_knobs()

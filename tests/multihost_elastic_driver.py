@@ -1,0 +1,57 @@
+"""Two-process elastic-recovery driver used by test_multihost.py (not a
+test itself).
+
+Attempt 0: worker 1 hard-kills itself mid-training (after the first
+checkpoint). The launcher detects the death, tears the cluster down and
+relaunches; the workers resume from the checkpoint and finish. The
+result files record the attempt that completed and the step the resumed
+session started from.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+import numpy as np  # noqa: E402
+
+import parallax_tpu as parallax  # noqa: E402
+from parallax_tpu.common import consts  # noqa: E402
+from parallax_tpu.models import simple  # noqa: E402
+
+STEPS = 30
+CRASH_STEP = 12
+CKPT_EVERY = 5
+
+
+def main():
+    out_path = sys.argv[1]
+    ckpt_dir = sys.argv[2]
+    attempt = int(os.environ.get(consts.PARALLAX_RESTART_ATTEMPT, "0"))
+    model = simple.build_model(learning_rate=0.1)
+    cfg = parallax.Config(run_option="AR", search_partitions=False)
+    cfg.ckpt_config.ckpt_dir = ckpt_dir
+    cfg.ckpt_config.save_ckpt_steps = CKPT_EVERY
+    sess, num_workers, worker_id, _ = parallax.parallel_run(
+        model, resource_info="localhost\n127.0.0.1",
+        parallax_config=cfg)
+    rng = np.random.default_rng(worker_id)
+    first_step = None
+    step = 0
+    while step < STEPS:
+        batch = simple.make_batch(rng, 32)
+        loss, step = sess.run(["loss", "global_step"], feed_dict=batch)
+        if first_step is None:
+            first_step = step
+        if attempt == 0 and step >= CRASH_STEP and worker_id == 1:
+            os._exit(17)  # simulated hardware failure
+    with open(f"{out_path}.worker{worker_id}", "w") as f:
+        f.write(f"attempt={attempt} first_step={first_step} "
+                f"step={step} loss={loss:.6f}\n")
+    sess.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,43 @@ def test_two_process_zigzag_ring_attention(tmp_path):
 
 
 @pytest.mark.slow
+def test_elastic_restart_resumes_from_checkpoint(tmp_path):
+    """Worker 1 hard-dies mid-training on attempt 0; with
+    PARALLAX_MAX_RESTARTS=1 the launcher relaunches the cluster and the
+    workers resume from the last checkpoint instead of step 0."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out = str(tmp_path / "elastic")
+    ckpt = str(tmp_path / "ckpt")
+    env = dict(os.environ)
+    env.update({
+        "PARALLAX_COORDINATOR_PORT": str(port),
+        "PARALLAX_MAX_RESTARTS": "1",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": os.getcwd() + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.pop("PARALLAX_RUN_OPTION", None)
+    proc = subprocess.run(
+        [sys.executable, "tests/multihost_elastic_driver.py", out, ckpt],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    from tests import multihost_elastic_driver as drv
+    for wid in (0, 1):
+        path = f"{out}.worker{wid}"
+        assert os.path.exists(path), proc.stderr[-2000:]
+        fields = dict(kv.split("=")
+                      for kv in open(path).read().split())
+        # the run that wrote results is the relaunch...
+        assert fields["attempt"] == "1", fields
+        # ...and it resumed from the checkpoint, not step 0
+        assert int(fields["first_step"]) > drv.CKPT_EVERY, fields
+        assert fields["step"] == str(drv.STEPS), fields
+
+
+@pytest.mark.slow
 def test_two_process_launch_and_training(tmp_path):
     import socket
     with socket.socket() as s:  # grab a free port; avoids collisions
