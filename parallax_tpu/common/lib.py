@@ -147,7 +147,7 @@ def remote_exec(command: str,
         f"export {k}={_shell_quote(str(v))};" for k, v in env.items())
     prefix = f"source {python_venv}/bin/activate; " if python_venv else ""
     full = f"{exports} {prefix}{command}"
-    if hostname in ("localhost", "127.0.0.1"):
+    if is_local_host(hostname):
         proc = subprocess.Popen(["bash", "-c", full], stdout=stdout,
                                 stderr=stderr)
     else:
@@ -158,9 +158,16 @@ def remote_exec(command: str,
     return proc
 
 
+def is_local_host(hostname: str) -> bool:
+    """Single source of truth for "this host runs commands locally, not
+    over ssh" (remote_exec, remote_copy, and the launcher's pid-file
+    teardown must agree on it)."""
+    return hostname in ("localhost", "127.0.0.1")
+
+
 def remote_copy(local_path: str, remote_path: str, hostname: str) -> None:
     """scp a file to a host (reference lib.py:70-76)."""
-    if hostname in ("localhost", "127.0.0.1"):
+    if is_local_host(hostname):
         if os.path.abspath(local_path) != os.path.abspath(remote_path):
             subprocess.check_call(["cp", local_path, remote_path])
         return

@@ -646,17 +646,10 @@ class Engine:
         overrides = self.model.batch_specs
 
         multiprocess = jax.process_count() > 1
-
-        def place(x, sharding):
-            if multiprocess:
-                # each host feeds its local slice of the global batch
-                # (reference: each worker's shard, shard.py semantics)
-                return jax.make_array_from_process_local_data(sharding, x)
-            return jax.device_put(x, sharding)
-
         transforms = self.model.feed_transforms
 
-        def put(name, x):
+        def resolve(name, x):
+            """-> (host array, target sharding) for one feed leaf."""
             x = np.asarray(x)
             if name in transforms:
                 x = np.asarray(transforms[name](x, self.mesh))
@@ -674,19 +667,38 @@ class Engine:
                         f"{x.shape[dim]} is not divisible by the "
                         f"{need}-way (local) mesh axes {axes} in its "
                         f"PartitionSpec; pad that dimension")
-                return place(x, NamedSharding(self.mesh, spec))
+                return x, NamedSharding(self.mesh, spec)
             local_n = max(1, n // jax.process_count())
             if x.ndim >= 1 and x.shape[0] % local_n != 0:
                 raise ValueError(
                     f"batch dimension {x.shape[0]} is not divisible by the "
                     f"{local_n} local devices of the mesh; pad the batch "
                     f"(or feed per-replica lists of equal size)")
-            return place(x, self.batch_sharding_fn(x.ndim))
+            return x, self.batch_sharding_fn(x.ndim)
 
         if isinstance(batch, dict):
-            return {k: jax.tree.map(lambda x, k=k: put(k, x), v)
-                    for k, v in batch.items()}
-        return jax.tree.map(lambda x: put("", x), batch)
+            resolved = {k: jax.tree.map(lambda x, k=k: resolve(k, x), v)
+                        for k, v in batch.items()}
+        else:
+            resolved = jax.tree.map(lambda x: resolve("", x), batch)
+        pairs_leaf = lambda v: (isinstance(v, tuple) and len(v) == 2
+                                and isinstance(v[1], NamedSharding))
+        if multiprocess:
+            # each host feeds its local slice of the global batch
+            # (reference: each worker's shard, shard.py semantics)
+            return jax.tree.map(
+                lambda v: jax.make_array_from_process_local_data(v[1],
+                                                                 v[0]),
+                resolved, is_leaf=pairs_leaf)
+        # one batched device_put for the whole feed dict: a single
+        # dispatch to the runtime instead of one host->device round
+        # trip per feed (the per-leaf form cost ~ms/step through a
+        # remote-tunnel backend)
+        flat, treedef = jax.tree_util.tree_flatten(resolved,
+                                                   is_leaf=pairs_leaf)
+        placed = jax.device_put([x for x, _ in flat],
+                                [s for _, s in flat])
+        return jax.tree_util.tree_unflatten(treedef, placed)
 
     def sparse_wire_bytes_per_step(self) -> Dict[str, int]:
         """Bytes-on-wire per step for the sparse path vs the dense

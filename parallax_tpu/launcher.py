@@ -22,8 +22,9 @@ import sys
 from typing import List, Sequence
 
 from parallax_tpu.common import consts
-from parallax_tpu.common.lib import (HostInfo, _shell_quote, parallax_log,
-                                     remote_exec, serialize_resource_info)
+from parallax_tpu.common.lib import (HostInfo, _shell_quote, is_local_host,
+                                     parallax_log, remote_exec,
+                                     serialize_resource_info)
 
 
 def launch_workers(hosts: Sequence[HostInfo],
@@ -42,9 +43,11 @@ def launch_workers(hosts: Sequence[HostInfo],
     ``has_checkpoint`` (ckpt_dir configured) training resumes from the
     last checkpoint via the session's implicit restore (checkpoint.py);
     without it the relaunch retrains from step 0 and the log says so.
-    Each attempt bumps the coordinator port so a half-dead coordinator
-    socket can't wedge the relaunch, and writes separate redirect logs
-    so the crashed attempt's diagnostics survive.
+    The coordinator port stays the SAME across attempts (operators pin
+    firewall holes to it; teardown is synchronous, so the listener is
+    freed before the relaunch binds it), and each attempt writes
+    separate redirect logs so the crashed attempt's diagnostics
+    survive.
 
     Returns the final attempt's exit code.
     """
@@ -73,10 +76,6 @@ def launch_workers(hosts: Sequence[HostInfo],
             "resume)")
 
 
-def _is_local(hostname: str) -> bool:
-    return hostname in ("localhost", "127.0.0.1")
-
-
 def _remote_kill(hostname: str, pidfile: str) -> None:
     """Kill the remote worker behind ``pidfile`` (INT, then KILL).
 
@@ -102,7 +101,7 @@ def _run_cluster_once(hosts: Sequence[HostInfo],
                       attempt: int) -> int:
     port = int(os.environ.get("PARALLAX_COORDINATOR_PORT",
                               consts.PARALLAX_COORDINATOR_PORT_DEFAULT))
-    coordinator = f"{hosts[0].hostname}:{port + attempt}"
+    coordinator = f"{hosts[0].hostname}:{port}"
     serialized = serialize_resource_info(hosts)
     cmd = (_shell_quote(sys.executable) + " "
            + " ".join(_shell_quote(a) for a in sys.argv))
@@ -135,7 +134,7 @@ def _run_cluster_once(hosts: Sequence[HostInfo],
         parallax_log.info("launching worker %d on %s", machine_id,
                           host.hostname)
         host_cmd = cmd
-        if not _is_local(host.hostname):
+        if not is_local_host(host.hostname):
             # record the worker's pid remotely so teardown can kill the
             # PROCESS, not just the local ssh client (exec makes the
             # python process own the recorded pid)
@@ -145,6 +144,11 @@ def _run_cluster_once(hosts: Sequence[HostInfo],
         procs.append((machine_id,
                       remote_exec(host_cmd, host.hostname, env=env,
                                   stdout=stdout, stderr=stderr)))
+        # the children inherited their own copies; keep the master's fd
+        # table flat across elastic restarts
+        for f in (stdout, stderr):
+            if f is not None:
+                f.close()
     chief = procs[-1][1]
     try:
         # Wait on the chief but abort the whole cluster as soon as ANY
@@ -176,9 +180,19 @@ def _run_cluster_once(hosts: Sequence[HostInfo],
                     p.send_signal(signal.SIGINT)
                 except OSError:
                     pass
-                if machine_id in pidfiles:
-                    _remote_kill(hosts[machine_id].hostname,
-                                 pidfiles[machine_id])
+        # Kill EVERY remote worker through its pid file, concurrently —
+        # even when the local ssh client already died (a dropped ssh
+        # connection leaves the remote python running; relaunching
+        # around such an orphan would double-write the checkpoint dir).
+        import threading
+        killers = [
+            threading.Thread(target=_remote_kill,
+                             args=(hosts[machine_id].hostname, pidfile))
+            for machine_id, pidfile in pidfiles.items()]
+        for t in killers:
+            t.start()
+        for t in killers:
+            t.join(timeout=60)
         for _, p in procs:
             try:
                 p.wait(timeout=30)

@@ -45,6 +45,16 @@ def log_uniform_prob(ids: jax.Array, vocab_size: int) -> jax.Array:
             / jnp.log(float(vocab_size + 1)))
 
 
+def _mxu_matmul(a: jax.Array, bt: jax.Array,
+                dtype: Optional[jnp.dtype]) -> jax.Array:
+    """``a @ bt.T`` with inputs cast to ``dtype`` (bf16: native MXU
+    rate) and float32 accumulation; ``dtype=None`` keeps the operands'
+    own precision (fp32 matmuls run at a fraction of MXU throughput)."""
+    if dtype is not None:
+        a, bt = a.astype(dtype), bt.astype(dtype)
+    return jnp.matmul(a, bt.T, preferred_element_type=jnp.float32)
+
+
 def sampled_softmax_loss(
     softmax_w: jax.Array,          # [V_padded, D] (row-sharded or not)
     softmax_b: jax.Array,          # [V_padded, 1] (column vector so the
@@ -56,12 +66,15 @@ def sampled_softmax_loss(
     num_samples: int,
     vocab_size: int,
     remove_accidental_hits: bool = True,
+    matmul_dtype: Optional[jnp.dtype] = jnp.bfloat16,
 ) -> jax.Array:
     """Per-example sampled-softmax cross-entropy, [N].
 
     One fused gather serves the label rows and the shared candidate rows
     (ids concatenated), so the sharded-embedding path pays a single
-    collective round per step for the whole softmax.
+    collective round per step for the whole softmax. The logits matmul
+    runs with ``matmul_dtype`` inputs and float32 accumulation (softmax
+    corrections, logsumexp and the loss stay float32 throughout).
     """
     n = hidden.shape[0]
     samples = log_uniform_candidates(rng, num_samples, vocab_size)
@@ -81,10 +94,13 @@ def sampled_softmax_loss(
         jnp.float32(num_samples)) + jnp.log(
         log_uniform_prob(samples, vocab_size))
 
-    logits_true = (jnp.sum(hidden * w_true, axis=-1) + b_true
-                   - logq_true)                                    # [N]
-    logits_samp = (hidden @ w_samp.T + b_samp[None, :]
-                   - logq_samp[None, :])                           # [N, S]
+    ht = hidden if matmul_dtype is None else hidden.astype(matmul_dtype)
+    wt = w_true if matmul_dtype is None else w_true.astype(matmul_dtype)
+    logits_true = (jnp.einsum("nd,nd->n", ht, wt,
+                              preferred_element_type=jnp.float32)
+                   + b_true - logq_true)                           # [N]
+    logits_samp = (_mxu_matmul(hidden, w_samp, matmul_dtype)
+                   + b_samp[None, :] - logq_samp[None, :])         # [N, S]
 
     if remove_accidental_hits:
         hit = samples[None, :] == labels[:, None]                  # [N, S]
@@ -96,10 +112,18 @@ def sampled_softmax_loss(
 
 
 def full_softmax_loss(softmax_w, softmax_b, hidden, labels,
-                      vocab_size: Optional[int] = None) -> jax.Array:
-    """Exact softmax loss (eval path; reference lm1b_eval.py).
-    ``softmax_b`` is the [V, 1] column vector used by the train path."""
-    logits = hidden @ softmax_w.T + softmax_b[:, 0][None, :]
+                      vocab_size: Optional[int] = None,
+                      matmul_dtype: Optional[jnp.dtype] = jnp.bfloat16
+                      ) -> jax.Array:
+    """Full-vocabulary softmax loss (eval path; reference lm1b_eval.py).
+    ``softmax_b`` is the [V, 1] column vector used by the train path.
+
+    The default runs the [N, D] x [D, V] logits matmul with bf16 inputs
+    and float32 accumulation (MXU-native rate; logits carry ~bf16 input
+    precision). Pass ``matmul_dtype=None`` for exact fp32 logits, e.g.
+    when publishing reference-comparable perplexities."""
+    logits = (_mxu_matmul(hidden, softmax_w, matmul_dtype)
+              + softmax_b[:, 0][None, :])
     if vocab_size is not None:
         logits = emb_ops.mask_padded_logits(logits, vocab_size)
     lse = jax.nn.logsumexp(logits, axis=1)

@@ -44,11 +44,16 @@ class TestSampledSoftmax:
         b = jnp.asarray(rng.standard_normal((V, 1)).astype(np.float32))
         h = jnp.asarray(rng.standard_normal((N, D)).astype(np.float32))
         labels = jnp.asarray(rng.integers(0, V, (N,)), jnp.int32)
-        got = ss.full_softmax_loss(w, b, h, labels)
+        got = ss.full_softmax_loss(w, b, h, labels, matmul_dtype=None)
         logits = h @ w.T + b[:, 0][None, :]
         expect = -jax.nn.log_softmax(logits)[jnp.arange(N), labels]
         np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
                                    rtol=1e-5)
+        # the default (bf16-input, fp32-accumulate MXU matmul) tracks
+        # the exact fp32 loss to bf16 input precision
+        fast = ss.full_softmax_loss(w, b, h, labels)
+        np.testing.assert_allclose(np.asarray(fast), np.asarray(expect),
+                                   rtol=2e-2, atol=5e-3)
 
     def test_sampled_gradients_train_the_full_softmax(self, rng):
         """The sampled loss value is not comparable to full CE (same as

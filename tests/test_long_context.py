@@ -85,3 +85,24 @@ def test_zigzag_ring_matches_contiguous_trajectory(rng):
         return losses
 
     np.testing.assert_allclose(run(True), run(False), rtol=2e-3)
+
+
+def test_remat_matches_non_remat_trajectory(rng):
+    """jax.checkpoint rematerialization changes memory, not math: the
+    trajectories track (recompute reorders bf16 rounding, so agreement
+    is to compute-dtype precision, not bit-exact)."""
+    batches = [lc.make_batch(rng, 4, 32, 512) for _ in range(3)]
+
+    def run(remat):
+        cfg = lc.tiny_config()
+        cfg.remat = remat
+        sess, *_ = parallax.parallel_run(
+            lc.build_model(cfg),
+            parallax_config=parallax.Config(run_option="HYBRID",
+                                            search_partitions=False),
+            num_partitions=4)
+        losses = [sess.run("loss", feed_dict=b) for b in batches]
+        sess.close()
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-3)

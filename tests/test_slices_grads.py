@@ -116,8 +116,10 @@ def test_slices_update_matches_reference_semantics():
                       st, lstm0)
     lstm_expect = jax.tree.map(np.asarray,
                                optax.apply_updates(lstm0, up))
+    # atol covers fused-vs-unfused rounding of the bf16-input logits
+    # matmul between the two compiled programs
     np.testing.assert_allclose(p1["lstm"]["w"], lstm_expect["w"],
-                               rtol=2e-5, atol=1e-7)
+                               rtol=2e-5, atol=1e-6)
     # tables: unclipped scatter adagrad on the dense cotangent's rows
     sl = SliceAdagrad(cfg.learning_rate, initial_accumulator_value=1.0)
     V = cfg.padded_vocab
@@ -127,8 +129,10 @@ def test_slices_update_matches_reference_semantics():
                         sl.init(jnp.asarray(p0["emb"])),
                         jnp.asarray(touched),
                         jnp.asarray(g_emb[touched]))
+    # atol covers fused-vs-unfused rounding of the bf16-input logits
+    # matmul between the two compiled programs
     np.testing.assert_allclose(p1["emb"], np.asarray(newp), rtol=2e-5,
-                               atol=1e-7)
+                               atol=1e-6)
 
 
 def test_slice_adagrad_duplicate_ids_combine_before_square():
