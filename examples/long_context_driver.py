@@ -36,13 +36,23 @@ def main():
                     choices=["gpipe", "1f1b"],
                     help="pipeline mode: gpipe (O(M) activations) or "
                          "1f1b (O(S) activations, fused fwd+bwd)")
+    ap.add_argument("--virtual_stages", type=int, default=1,
+                    help="pipeline mode: interleaved chunks per device "
+                         "(>1 needs --partitions; bubble shrinks "
+                         "virtual_stages-fold)")
     ap.add_argument("--pallas_attention", action="store_true",
                     help="fuse attention with the Pallas flash kernel "
                          "(data/tensor modes)")
     ap.add_argument("--zigzag", action="store_true",
                     help="balanced causal placement for ring mode")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize blocks in the backward "
+                         "(jax.checkpoint): O(1)-block activations")
     args = ap.parse_args()
 
+    if args.virtual_stages > 1 and not args.partitions:
+        ap.error("--virtual_stages > 1 requires --partitions (the "
+                 "stage count fixes the device-major layer order)")
     cfg = lc.LongContextConfig(vocab_size=args.vocab_size,
                                model_dim=args.model_dim,
                                num_layers=args.num_layers,
@@ -51,6 +61,11 @@ def main():
                                zigzag=args.zigzag,
                                num_microbatches=args.num_microbatches,
                                pipeline_schedule=args.pipeline_schedule,
+                               virtual_stages=args.virtual_stages,
+                               pipeline_stages=(args.partitions
+                                                if args.virtual_stages > 1
+                                                else None),
+                               remat=args.remat,
                                use_pallas_attention=args.pallas_attention)
     sess, _, worker_id, _ = parallax.parallel_run(
         lc.build_model(cfg), args.resource_info,

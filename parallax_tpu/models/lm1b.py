@@ -65,6 +65,11 @@ class LM1BConfig:
     # Requires Config(sparse_grad_mode="slices"). "dense": all grads
     # dense, clip covers every variable (round-1 behavior).
     sparse_grad_mode: str = "dense"
+    # lax.scan unroll factor for the LSTM time loop: >1 trades compiled
+    # code size for fewer loop iterations (amortizes the per-iteration
+    # loop overhead that dominates small-batch recurrent steps on TPU).
+    # T % unroll need not hold (lax.scan handles remainders).
+    lstm_scan_unroll: int = 1
 
     @property
     def padded_vocab(self) -> int:
@@ -124,7 +129,8 @@ def build_model(cfg: LM1BConfig, full_softmax: bool = False) -> Model:
 
         c0 = jnp.zeros((B, H), cfg.compute_dtype)
         h0 = jnp.zeros((B, P), cfg.compute_dtype)
-        (_, _), hs = jax.lax.scan(cell, (c0, h0), x_seq)
+        (_, _), hs = jax.lax.scan(cell, (c0, h0), x_seq,
+                                  unroll=max(1, cfg.lstm_scan_unroll))
         return hs
 
     def loss_fn(params, batch, rng):
