@@ -62,7 +62,10 @@ def main():
         vocab_size=args.vocab_size, emb_dim=args.emb_dim,
         hidden_dim=args.hidden_dim, proj_dim=args.proj_dim,
         num_partitions=parallax.get_partitioner(args.partitions),
-        keep_prob=1.0)
+        keep_prob=1.0,
+        # published perplexities must be reference-comparable: full
+        # fp32 eval, no bf16 matmuls
+        compute_dtype=jnp.float32)
     params, step = restore_params(args.ckpt_dir, cfg)
     print(f"restored step {step}")
 

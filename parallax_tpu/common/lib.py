@@ -161,8 +161,20 @@ def remote_exec(command: str,
 def is_local_host(hostname: str) -> bool:
     """Single source of truth for "this host runs commands locally, not
     over ssh" (remote_exec, remote_copy, and the launcher's pid-file
-    teardown must agree on it)."""
-    return hostname in ("localhost", "127.0.0.1")
+    teardown must agree on it).
+
+    Matches loopback names AND this machine's own hostname/FQDN — a
+    resource file listing the master's real hostname must not make the
+    master ssh to itself or take the remote pid-file kill path for a
+    local child (the reference had exactly that wart)."""
+    if hostname in ("localhost", "127.0.0.1", "::1"):
+        return True
+    import socket
+    try:
+        own = {socket.gethostname(), socket.getfqdn()}
+    except OSError:  # resolver trouble: fall back to loopback-only
+        return False
+    return hostname in own
 
 
 def remote_copy(local_path: str, remote_path: str, hostname: str) -> None:

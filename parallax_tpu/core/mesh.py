@@ -113,8 +113,14 @@ def _order_by_domain(devices, p: int):
     # rings nest inside domains when every domain splits into whole
     # rings (sizes may differ); with equal sizes a bigger ring may
     # still span whole domains, keeping repl rows aligned
-    if all(len(v) % p == 0 for v in domains.values()) or (
-            len(sizes) == 1 and p % next(iter(sizes)) == 0):
+    if all(len(v) % p == 0 for v in domains.values()):
+        return [d for k in sorted(domains) for d in domains[k]]
+    if len(sizes) == 1 and p % next(iter(sizes)) == 0:
+        parallax_log.warning(
+            "shard axis %d spans %d whole connectivity domain(s) of "
+            "size %d: devices are grouped, but shard collectives "
+            "still cross DCN", p, p // next(iter(sizes)),
+            next(iter(sizes)))
         return [d for k in sorted(domains) for d in domains[k]]
     parallax_log.warning(
         "shard axis %d does not nest in the connectivity domains "

@@ -56,8 +56,12 @@ def launch_workers(hosts: Sequence[HostInfo],
                                           "0"))
     attempt = 0
     while True:
-        rc = _run_cluster_once(hosts, redirect_path, attempt)
-        if rc == 0 or rc == 130:      # success, or user interrupt
+        rc, user_interrupt = _run_cluster_once(hosts, redirect_path,
+                                               attempt)
+        # Only a KeyboardInterrupt caught HERE suppresses restarts; a
+        # worker exiting 130 (SIGINT from infra, or our own abort
+        # propagation) is a genuine failure and must retry.
+        if rc == 0 or user_interrupt:
             return rc
         if attempt >= max_restarts:
             if max_restarts:
@@ -82,11 +86,18 @@ def _remote_kill(hostname: str, pidfile: str) -> None:
     SIGINT on the local ssh client only kills the client — the remote
     python would keep running and a relaunch would double-write the
     checkpoint dir. The worker's pid was recorded at spawn (`echo $$`
-    before `exec`), so this reaches the real process."""
+    before `exec`), so this reaches the real process.
+
+    Safety: the recorded pid may have been recycled (or the pidfile
+    pre-created by another party), so the kill is gated on the live
+    process actually being a python of the launching user — never
+    ``kill -9`` an arbitrary pid from a file."""
     import subprocess
-    kill_cmd = (f"if [ -f {pidfile} ]; then "
-                f"kill -INT $(cat {pidfile}) 2>/dev/null; sleep 5; "
-                f"kill -9 $(cat {pidfile}) 2>/dev/null; "
+    check = "grep -aq python /proc/$p/cmdline 2>/dev/null"
+    kill_cmd = (f"if [ -f {pidfile} ]; then p=$(cat {pidfile}); "
+                f"if {check}; then "
+                f"kill -INT $p 2>/dev/null; sleep 5; "
+                f"{check} && kill -9 $p 2>/dev/null; fi; "
                 f"rm -f {pidfile}; fi")
     try:
         subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no",
@@ -98,14 +109,21 @@ def _remote_kill(hostname: str, pidfile: str) -> None:
 
 def _run_cluster_once(hosts: Sequence[HostInfo],
                       redirect_path: str | None,
-                      attempt: int) -> int:
+                      attempt: int) -> tuple:
+    """One cluster attempt. Returns ``(rc, user_interrupt)`` where
+    ``user_interrupt`` is True only for a KeyboardInterrupt caught in
+    THIS process (a worker's own rc=130 is a failure, not an
+    interrupt)."""
+    import secrets
     port = int(os.environ.get("PARALLAX_COORDINATOR_PORT",
                               consts.PARALLAX_COORDINATOR_PORT_DEFAULT))
     coordinator = f"{hosts[0].hostname}:{port}"
     serialized = serialize_resource_info(hosts)
     cmd = (_shell_quote(sys.executable) + " "
            + " ".join(_shell_quote(a) for a in sys.argv))
-    tag = f"{os.getpid()}_{attempt}"
+    # unpredictable per-run token: a fixed /tmp name could be pre-created
+    # (or collide across users) and aim the teardown kill at a stranger
+    tag = f"{os.getpid()}_{attempt}_{secrets.token_hex(8)}"
     pidfiles = {}             # machine_id -> remote pid file
     procs: List = []          # (machine_id, Popen)
     # Reverse order, chief last (reference ps/runner.py:163-193: the chief
@@ -136,11 +154,13 @@ def _run_cluster_once(hosts: Sequence[HostInfo],
         host_cmd = cmd
         if not is_local_host(host.hostname):
             # record the worker's pid remotely so teardown can kill the
-            # PROCESS, not just the local ssh client (exec makes the
-            # python process own the recorded pid)
+            # PROCESS, not just the local ssh client; the wrapper also
+            # removes the pidfile on normal exit so stale files never
+            # accumulate (or aim a later kill at a recycled pid)
             pidfile = f"/tmp/parallax_{tag}_{machine_id}.pid"
             pidfiles[machine_id] = pidfile
-            host_cmd = f"echo $$ > {pidfile}; exec {cmd}"
+            host_cmd = (f"{cmd} & c=$!; echo $c > {pidfile}; "
+                        f"wait $c; rc=$?; rm -f {pidfile}; exit $rc")
         procs.append((machine_id,
                       remote_exec(host_cmd, host.hostname, env=env,
                                   stdout=stdout, stderr=stderr)))
@@ -150,6 +170,7 @@ def _run_cluster_once(hosts: Sequence[HostInfo],
             if f is not None:
                 f.close()
     chief = procs[-1][1]
+    user_interrupt = False
     try:
         # Wait on the chief but abort the whole cluster as soon as ANY
         # worker dies (the reference master only watched the chief,
@@ -173,22 +194,27 @@ def _run_cluster_once(hosts: Sequence[HostInfo],
             break
     except KeyboardInterrupt:
         rc = 130
+        user_interrupt = True
     finally:
+        # Clean exits need no kill: the spawn wrapper already removed
+        # their pidfile and there is no process left. Only workers whose
+        # ssh client is still live, or that exited non-zero (client died
+        # / connection dropped — the remote python may linger), get the
+        # pidfile kill.
+        clean = {machine_id for machine_id, p in procs
+                 if p.poll() == 0}
         for machine_id, p in procs:
             if p.poll() is None:
                 try:
                     p.send_signal(signal.SIGINT)
                 except OSError:
                     pass
-        # Kill EVERY remote worker through its pid file, concurrently —
-        # even when the local ssh client already died (a dropped ssh
-        # connection leaves the remote python running; relaunching
-        # around such an orphan would double-write the checkpoint dir).
         import threading
         killers = [
             threading.Thread(target=_remote_kill,
                              args=(hosts[machine_id].hostname, pidfile))
-            for machine_id, pidfile in pidfiles.items()]
+            for machine_id, pidfile in pidfiles.items()
+            if machine_id not in clean]
         for t in killers:
             t.start()
         for t in killers:
@@ -198,7 +224,7 @@ def _run_cluster_once(hosts: Sequence[HostInfo],
                 p.wait(timeout=30)
             except Exception:
                 p.kill()
-    return rc
+    return rc, user_interrupt
 
 
 def init_worker_distributed() -> None:

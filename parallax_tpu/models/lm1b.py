@@ -161,9 +161,14 @@ def build_model(cfg: LM1BConfig, full_softmax: bool = False) -> Model:
 
         labels = y.reshape(B * T)
         if full_softmax:
+            # train-baseline semantics: the model's compute dtype governs
+            # the logits matmul (bf16 by default — explicit opt-in; the
+            # op itself defaults to fp32 for eval parity)
+            mm = (None if cfg.compute_dtype == jnp.float32
+                  else cfg.compute_dtype)
             losses = ss_ops.full_softmax_loss(
                 params["softmax_w"], params["softmax_b"], hidden, labels,
-                cfg.vocab_size)                                 # [B*T]
+                cfg.vocab_size, matmul_dtype=mm)                # [B*T]
         else:
             losses = ss_ops.sampled_softmax_loss(
                 params["softmax_w"], params["softmax_b"], hidden, labels,

@@ -231,9 +231,14 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
     # occurrence counts cross the wire only when the dedup stage is
     # active AND averaging (the raw path derives them locally)
     has_counts = ctx.average_duplicates and cap is not None
+    # Row-grad cotangents carry the table's dtype (JAX cotangent dtype ==
+    # primal dtype), so the bytes model must not assume fp32: a bf16
+    # table halves the grad planes while the int32 id/count planes stay
+    # 4 bytes — near the crossover that flips the cheaper side.
+    elem = jnp.dtype(table.dtype).itemsize
     sparse_repl = _choose_sparse_repl(
         ctx.mesh, table.shape, cap_eff, has_counts,
-        ctx.cross_replica_sparse_hint)
+        ctx.cross_replica_sparse_hint, elem)
     if ctx.records is not None:
         # guarded capacities record the declared (compressed) size; an
         # overflow step pays the raw n_dev cost for that step instead
@@ -242,7 +247,7 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
         ctx.records.append((tuple(table.shape), n_eff, n_cnt,
                             _cross_replica_bytes(
                                 ctx.mesh, table.shape, cap_eff,
-                                has_counts, sparse_repl)))
+                                has_counts, sparse_repl, elem)))
     if ctx.average_duplicates or sparse_repl:
         rows = _sharded_lookup_manual(table, ids, ctx.mesh, cap, guarded,
                                       ctx.average_duplicates, sparse_repl)
@@ -254,7 +259,7 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
 
 
 def _cross_replica_bytes(mesh, table_shape, cap_eff: int, counts: bool,
-                         sparse_repl: bool) -> int:
+                         sparse_repl: bool, elem_bytes: int = 4) -> int:
     """Mesh-TOTAL bytes the table-grad combine moves ACROSS the 'repl'
     axis per step (zero when repl == 1; same unit as the mesh-total
     shard-exchange terms in the engine's accounting). Dense: every
@@ -262,7 +267,9 @@ def _cross_replica_bytes(mesh, table_shape, cap_eff: int, counts: bool,
     every device additionally receives the other (repl-1) rows' deduped
     ids/grads in the full-mesh gather. ``counts`` adds the occurrence-
     count plane (shipped only when the dedup stage is active AND
-    averaging — the raw path derives counts locally)."""
+    averaging — the raw path derives counts locally). ``elem_bytes`` is
+    the row-grad element size (the table's dtype — cotangents match the
+    primal dtype); id/count planes are always int32."""
     r = mesh.shape[AXIS_REPL]
     if r <= 1:
         return 0
@@ -271,13 +278,14 @@ def _cross_replica_bytes(mesh, table_shape, cap_eff: int, counts: bool,
     V = int(table_shape[0])
     D = int(np.prod(table_shape[1:])) if len(table_shape) > 1 else 1
     if sparse_repl:
-        per_slot = D * 4 + 4 + (4 if counts else 0)  # rows + ids (+cnt)
+        per_slot = D * elem_bytes + 4 + (4 if counts else 0)
         return n * (r - 1) * p * cap_eff * per_slot
-    return int(n * 2 * (r - 1) / r * (V // p) * D * 4)
+    return int(n * 2 * (r - 1) / r * (V // p) * D * elem_bytes)
 
 
 def _choose_sparse_repl(mesh, table_shape, cap_eff: int, counts: bool,
-                        hint: Optional[bool]) -> bool:
+                        hint: Optional[bool],
+                        elem_bytes: int = 4) -> bool:
     """Static choice of the cross-replica combine: gather only deduped
     rows over the whole mesh vs dense psum of the shard grad over
     'repl' (the axis that crosses slices/DCN under the slice-aware
@@ -288,9 +296,9 @@ def _choose_sparse_repl(mesh, table_shape, cap_eff: int, counts: bool,
     if hint is not None:
         return bool(hint)
     return (_cross_replica_bytes(mesh, table_shape, cap_eff, counts,
-                                 True)
+                                 True, elem_bytes)
             < _cross_replica_bytes(mesh, table_shape, cap_eff, counts,
-                                   False))
+                                   False, elem_bytes))
 
 
 def _dedup_capacity(table_shape, ids_shape, mesh,

@@ -113,15 +113,16 @@ def sampled_softmax_loss(
 
 def full_softmax_loss(softmax_w, softmax_b, hidden, labels,
                       vocab_size: Optional[int] = None,
-                      matmul_dtype: Optional[jnp.dtype] = jnp.bfloat16
+                      matmul_dtype: Optional[jnp.dtype] = None
                       ) -> jax.Array:
     """Full-vocabulary softmax loss (eval path; reference lm1b_eval.py).
     ``softmax_b`` is the [V, 1] column vector used by the train path.
 
-    The default runs the [N, D] x [D, V] logits matmul with bf16 inputs
-    and float32 accumulation (MXU-native rate; logits carry ~bf16 input
-    precision). Pass ``matmul_dtype=None`` for exact fp32 logits, e.g.
-    when publishing reference-comparable perplexities."""
+    The default computes exact fp32 logits — this is the eval/parity
+    path, and its perplexities must stay reference-comparable without
+    callers knowing about dtypes. Pass ``matmul_dtype=jnp.bfloat16`` to
+    opt into the MXU-native bf16-in/fp32-accumulate matmul (what the
+    lm1b train-baseline model does via its compute dtype)."""
     logits = (_mxu_matmul(hidden, softmax_w, matmul_dtype)
               + softmax_b[:, 0][None, :])
     if vocab_size is not None:

@@ -136,7 +136,8 @@ class ParallaxSession:
         self._recent_times.append(time.perf_counter())
         new_step = step + 1
         self._host_step = new_step
-        self._ckpt.maybe_save(new_step, self._state)
+        if self._ckpt.maybe_save(new_step, self._state):
+            self._warn_sparse_overflow("checkpoint")
         if self._search is not None:
             self._record_search_time(dt)
         return self._convert_fetch(fetches, outputs)
@@ -236,7 +237,20 @@ class ParallaxSession:
                 f"fetch {name!r} unknown; available: {sorted(outputs)}")
         return outputs[name]
 
+    def _warn_sparse_overflow(self, where: str) -> None:
+        """A user who never polls sparse_overflow_steps() must still hear
+        that row_sparse_adagrad dropped updates (silent data corruption
+        otherwise) — warn at every checkpoint and at close."""
+        n = self.sparse_overflow_steps()
+        if n > 0:
+            parallax_log.warning(
+                "row_sparse_adagrad overflowed max_touched_rows on %d "
+                "step(s) so far (detected at %s): the lowest-activity "
+                "rows of those steps' sparse updates were DROPPED. "
+                "Raise max_touched_rows.", n, where)
+
     def close(self):
+        self._warn_sparse_overflow("close")
         self._ckpt.close()
         if self._engine is not None:
             self._engine.close()

@@ -1,0 +1,99 @@
+"""Flagship wire-bytes accounting: sparse path vs dense all-reduce.
+
+The BASELINE.json north-star secondary metric is "sparse-grad bytes on
+wire" — the reference's PS win is shipping only the touched (ids, rows)
+of the 793k-vocab embedding/softmax tables instead of dense [V, D]
+gradients (reference: graph_transform_lib.py:1041-1211). The accounting
+is trace-time (ops/embedding.py records per-lookup wire terms while the
+step traces), so the REAL flagship config can be measured anywhere: this
+script abstractly evaluates the full hybrid training step (no parameter
+allocation, no execution) on an 8-virtual-device CPU mesh and prints the
+accounting as one JSON line.
+
+Run: python tools/wire_bytes_report.py [--out WIRE_BYTES.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count"
+                                 "=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def flagship_accounting(n_chips: int = 8, batch_per_chip: int = 128,
+                        num_steps: int = 20):
+    """Build the bench's flagship engine (793,470-vocab LM1B, HYBRID,
+    slices mode) and return its wire-bytes accounting from an abstract
+    trace of one training step."""
+    import jax
+    import numpy as np
+
+    from parallax_tpu.common.config import ParallaxConfig
+    from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
+    from parallax_tpu.models import lm1b
+
+    devices = jax.devices()[:n_chips]
+    mesh = mesh_lib.build_mesh(devices, num_partitions=n_chips)
+    cfg = lm1b.LM1BConfig(num_partitions=n_chips,
+                          sparse_grad_mode="slices")
+    model = lm1b.build_model(cfg)
+    batch = lm1b.make_batch(np.random.default_rng(0),
+                            batch_per_chip * n_chips, num_steps,
+                            cfg.vocab_size)
+    config = ParallaxConfig(run_option="HYBRID", search_partitions=False,
+                            sparse_grad_mode="slices")
+    eng = engine_lib.Engine(model, mesh, config, batch)
+
+    # Abstract evaluation: traces the step (filling the per-lookup wire
+    # records) without allocating the 793k-vocab tables or running math.
+    abstract_state = jax.eval_shape(eng._init_jit, 0)
+    abstract_batch = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                      for k, v in eng.shard_batch(batch).items()}
+    with eng.mesh:
+        jax.eval_shape(eng._step_jit, abstract_state, abstract_batch)
+    wire = eng.sparse_wire_bytes_per_step()
+    return {
+        "config": {
+            "model": "lm1b", "vocab_size": cfg.vocab_size,
+            "emb_dim": cfg.emb_dim, "proj_dim": cfg.proj_dim,
+            "batch_size": batch_per_chip * n_chips,
+            "num_steps": num_steps, "n_chips": n_chips,
+            "run_option": "HYBRID", "sparse_grad_mode": "slices",
+        },
+        **wire,
+        "sparse_over_dense": (wire["sparse_path_bytes"]
+                              / wire["dense_allreduce_bytes"]
+                              if wire.get("dense_allreduce_bytes")
+                              else None),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON to this path")
+    ap.add_argument("--n_chips", type=int, default=8)
+    ap.add_argument("--batch_per_chip", type=int, default=128)
+    args = ap.parse_args()
+    result = flagship_accounting(args.n_chips, args.batch_per_chip)
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
