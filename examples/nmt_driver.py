@@ -1,7 +1,9 @@
 """NMT driver (reference: examples/nmt/nmt_distributed_driver.py).
 
 Transformer seq2seq with the shared embedding on the sparse path;
-synthetic parallel corpus unless --data_path provides token streams.
+synthetic parallel corpus, or file-based vocab + parallel corpus via
+--vocab_file/--src_file/--tgt_file (reference: examples/nmt/utils/
+vocab_utils.py + iterator_utils.py; see parallax_tpu/data/nmt_data.py).
 """
 
 import argparse
@@ -29,25 +31,64 @@ def main():
     ap.add_argument("--pallas_attention", action="store_true",
                     help="fuse all three attention types with the "
                          "Pallas flash kernels")
+    ap.add_argument("--tensor_parallel", action="store_true",
+                    help="Megatron TP over the 'shard' mesh axis "
+                         "(ops/tensor_parallel.py)")
+    ap.add_argument("--vocab_file", default=None)
+    ap.add_argument("--src_file", default=None)
+    ap.add_argument("--tgt_file", default=None)
     args = ap.parse_args()
+    if args.src_file and not (args.vocab_file and args.tgt_file):
+        ap.error("--src_file requires --vocab_file and --tgt_file")
 
     num_partitions = parallax.get_partitioner(args.partitions)
-    cfg = nmt.NMTConfig(vocab_size=args.vocab_size,
+    vocab, batches = None, None
+    vocab_size = args.vocab_size
+    if args.vocab_file:
+        from parallax_tpu.data import nmt_data
+        vocab = nmt_data.Vocab.load(args.vocab_file)
+        vocab_size = len(vocab)
+    cfg = nmt.NMTConfig(vocab_size=vocab_size,
                         model_dim=args.model_dim,
                         num_layers=args.num_layers,
                         max_len=max(args.src_len, args.tgt_len),
                         use_pallas_attention=args.pallas_attention,
+                        tensor_parallel=args.tensor_parallel,
                         num_partitions=num_partitions)
     sess, num_workers, worker_id, _ = parallax.parallel_run(
         nmt.build_model(cfg), args.resource_info,
         parallax_config=parallax.Config(run_option=args.run_option),
         num_partitions=num_partitions)
 
+    if args.src_file:
+        from parallax_tpu.data import nmt_data
+        pairs = nmt_data.load_parallel_corpus(
+            args.src_file, args.tgt_file, vocab, cfg.max_len)
+        it = nmt_data.NMTBatchIterator(
+            pairs, batch_size=args.batch_size, max_len=cfg.max_len,
+            num_shards=num_workers, shard_index=worker_id)
+
+        def batches():
+            epoch = 0
+            while True:
+                n = 0
+                for b in it.epoch(epoch):
+                    n += 1
+                    yield b
+                if n == 0:
+                    raise ValueError(
+                        f"corpus yields no batches at batch_size="
+                        f"{args.batch_size} (corpus {len(pairs)} pairs); "
+                        f"lower --batch_size")
+                epoch += 1
+        batches = batches()
+
     rng = np.random.default_rng(worker_id)
     words, t_last = 0.0, time.perf_counter()
     for i in range(args.max_steps):
-        batch = nmt.make_batch(rng, args.batch_size, args.src_len,
-                               args.tgt_len, cfg.vocab_size)
+        batch = (next(batches) if batches is not None
+                 else nmt.make_batch(rng, args.batch_size, args.src_len,
+                                     args.tgt_len, cfg.vocab_size))
         loss, w, step = sess.run(["loss", "words", "global_step"],
                                  feed_dict=batch)
         words += w

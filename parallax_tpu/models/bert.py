@@ -23,6 +23,7 @@ import optax
 
 from parallax_tpu.core.engine import Model
 from parallax_tpu.ops import embedding as emb_ops
+from parallax_tpu.ops import tensor_parallel as tp_ops
 
 
 @dataclasses.dataclass
@@ -38,6 +39,14 @@ class BertConfig:
     # fuse attention (incl. the WordPiece padding mask) with the Pallas
     # flash kernel
     use_pallas_attention: bool = False
+    # Megatron tensor parallelism over the 'shard' mesh axis
+    # (ops/tensor_parallel.py): column-parallel qkv/up-proj, row-parallel
+    # out/down-proj, heads computed H/tp per device. The WordPiece
+    # embedding keeps riding the row-sharded sparse path — TP and the
+    # reference-style embedding sharding compose on the same axis.
+    tensor_parallel: bool = False
+    # TP×SP composition: between-block activations rest seq-sharded
+    tp_sequence_parallel: bool = False
     num_partitions: Optional[int] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
 
@@ -98,6 +107,10 @@ def build_model(cfg: BertConfig) -> Model:
         B, T, _ = x.shape
         Hn = cfg.num_heads
         hd = D // Hn
+        if cfg.tensor_parallel:
+            return tp_ops.tp_attention(
+                x, x, p, Hn, kv_mask=pad_mask, dtype=dt,
+                sequence_parallel=cfg.tp_sequence_parallel)
         qkv = x @ p["wqkv"].astype(dt)
         q, k, v = jnp.split(qkv, 3, -1)
 
@@ -133,8 +146,16 @@ def build_model(cfg: BertConfig) -> Model:
 
         for p in params["blocks"]:
             x = layer_norm(x + attention(x, p, pad_mask), p["ln1"])
-            h = jax.nn.gelu(x @ p["w1"].astype(dt)) @ p["w2"].astype(dt)
+            if cfg.tensor_parallel:
+                h = tp_ops.tp_mlp(
+                    x, p["w1"], p["w2"], act=jax.nn.gelu, dtype=dt,
+                    sequence_parallel=cfg.tp_sequence_parallel)
+            else:
+                h = (jax.nn.gelu(x @ p["w1"].astype(dt))
+                     @ p["w2"].astype(dt))
             x = layer_norm(x + h, p["ln2"])
+            if cfg.tensor_parallel and cfg.tp_sequence_parallel:
+                x = tp_ops.seq_shard(x)
 
         # MLM over masked positions only: [B, M] gathers
         mpos = batch["mask_positions"]                     # [B, M] int32
@@ -164,10 +185,22 @@ def build_model(cfg: BertConfig) -> Model:
 
     tx = optax.chain(optax.clip_by_global_norm(1.0),
                      optax.adamw(cfg.learning_rate, weight_decay=0.01))
+    specs, bspecs = {}, {}
+    if cfg.tensor_parallel:
+        specs = {**tp_ops.attention_param_specs("blocks/*"),
+                 **tp_ops.mlp_param_specs("blocks/*")}
+        # batch rides 'repl' only — 'shard' is the TP axis
+        from jax.sharding import PartitionSpec as P
+        from parallax_tpu.core.mesh import AXIS_REPL
+        bspecs = {k: P(AXIS_REPL, None)
+                  for k in ("input_ids", "segment_ids", "mask_positions",
+                            "mask_labels", "mask_weights")}
+        bspecs["next_sentence_label"] = P(AXIS_REPL)
     # type_emb is gathered but tiny (2 rows) — keep it replicated rather
     # than letting the classifier try to shard it
     return Model(init_fn, loss_fn, optimizer=tx,
-                 dense_params=("type_emb",))
+                 dense_params=("type_emb",), param_specs=specs,
+                 batch_specs=bspecs)
 
 
 def make_batch(rng: np.random.Generator, batch_size: int, seq_len: int,

@@ -51,6 +51,11 @@ class LM1BConfig:
     learning_rate: float = 0.2
     num_partitions: Optional[int] = None  # None -> pad for device count
     compute_dtype: jnp.dtype = jnp.bfloat16
+    # dtype of the big gather-only tables (emb/softmax_w/softmax_b) and
+    # therefore of every row plane the sparse path puts on the wire —
+    # bf16 halves the dominant wire term (and the slice-adagrad
+    # accumulators; the LSTM stack and its optimizer stay fp32).
+    table_dtype: jnp.dtype = jnp.float32
     # Scatter-only adagrad over touched table rows (reference
     # SparseApplyAdagrad, graph_transform_lib.py:71-77). Must bound the
     # distinct rows a step touches on emb (batch·num_steps ids) and
@@ -98,16 +103,17 @@ def build_model(cfg: LM1BConfig, full_softmax: bool = False) -> Model:
         u = lambda k, shape, s: jax.random.uniform(k, shape, jnp.float32,
                                                    -s, s)
         scale = 1.0 / np.sqrt(E)
+        td = cfg.table_dtype
         return {
-            "emb": u(ks[0], (V, E), scale),
+            "emb": u(ks[0], (V, E), scale).astype(td),
             "lstm": {
                 # one fused kernel for [x, h_proj] -> gates
                 "w": u(ks[1], (E + P, 4 * H), 1.0 / np.sqrt(E + P)),
                 "b": jnp.zeros((4 * H,), jnp.float32),
                 "w_proj": u(ks[2], (H, P), 1.0 / np.sqrt(H)),
             },
-            "softmax_w": u(ks[3], (V, P), 1.0 / np.sqrt(P)),
-            "softmax_b": jnp.zeros((V, 1), jnp.float32),
+            "softmax_w": u(ks[3], (V, P), 1.0 / np.sqrt(P)).astype(td),
+            "softmax_b": jnp.zeros((V, 1), td),
         }
 
     def lstm_scan(lstm, x_seq):

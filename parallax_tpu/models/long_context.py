@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 from parallax_tpu.core.engine import Model
 from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD
 from parallax_tpu.ops import embedding as emb_ops
+from parallax_tpu.ops import tensor_parallel as tp_ops
 from parallax_tpu.ops.ring_attention import (full_attention_reference,
                                              inverse_zigzag_permutation,
                                              ring_attention,
@@ -40,8 +41,9 @@ class LongContextConfig:
     learning_rate: float = 3e-4
     # 'ring'    : sequence parallelism — seq over 'shard', ring attention
     # 'tensor'  : tensor parallelism — Megatron column/row-parallel
-    #             kernels over 'shard' (GSPMD inserts the psum after the
-    #             row-parallel matmul), batch data-parallel over 'repl'
+    #             kernels over 'shard' (ops/tensor_parallel.py; GSPMD
+    #             inserts the psum after each row-parallel matmul),
+    #             batch data-parallel over 'repl'
     # 'pipeline': pipeline parallelism — layer stages over 'shard',
     #             GPipe microbatch pipelining (ops/pipeline.py), batch
     #             data-parallel over 'repl'
@@ -64,10 +66,21 @@ class LongContextConfig:
     # order at init so no in-graph cross-shard permute is ever needed.
     virtual_stages: int = 1
     pipeline_stages: Optional[int] = None
+    # Megatron sequence parallelism composed with TP (tensor mode only):
+    # between-block activations rest sequence-sharded over the same
+    # 'shard' axis — the closing all-reduce of each block becomes a
+    # reduce-scatter and the entry matmuls re-gather, so norms/residuals
+    # hold T/tp tokens per device (ops/tensor_parallel.py docstring).
+    tp_sequence_parallel: bool = False
     # zig-zag sequence placement in ring mode: balances the causal
     # workload across the ring (each device holds a low block and its
-    # mirrored high block); the engine permutes the fed ids host-side
-    zigzag: bool = False
+    # mirrored high block; ops/ring_attention.py computes maskless
+    # half-tiles for foreign blocks — ~2x attention wall-clock at large
+    # rings, perf/zigzag_balance.json). The permute happens in-graph, so
+    # feeds stay natural-order. None (default) = AUTO: zigzag whenever
+    # the sequence length divides 2*ring (its only extra requirement),
+    # contiguous otherwise; True/False forces.
+    zigzag: Optional[bool] = None
     # fuse attention with the Pallas flash kernel (data/tensor modes;
     # ring mode has its own collective-fused path)
     use_pallas_attention: bool = False
@@ -101,6 +114,9 @@ def build_model(cfg: LongContextConfig) -> Model:
     if cfg.zigzag and cfg.parallelism != "ring":
         raise ValueError(
             "zigzag placement only applies to parallelism='ring'")
+    if cfg.tp_sequence_parallel and cfg.parallelism != "tensor":
+        raise ValueError(
+            "tp_sequence_parallel only applies to parallelism='tensor'")
     Vp = int(cfg.virtual_stages)
     if Vp > 1:
         if cfg.parallelism != "pipeline":
@@ -134,9 +150,19 @@ def build_model(cfg: LongContextConfig) -> Model:
                 for g in stage_order_permutation(S, Vp)
                 for j in range(pc)]
 
-    def _zigzag_active(mesh) -> bool:
-        return (cfg.zigzag and cfg.parallelism == "ring"
-                and mesh is not None and mesh.shape[AXIS_SHARD] > 1)
+    def _zigzag_active(mesh, T: int) -> bool:
+        if (cfg.parallelism != "ring" or mesh is None
+                or mesh.shape[AXIS_SHARD] <= 1):
+            return False
+        fits = T % (2 * mesh.shape[AXIS_SHARD]) == 0
+        if cfg.zigzag is None:
+            return fits
+        if cfg.zigzag and not fits:
+            raise ValueError(
+                f"zigzag placement needs sequence length divisible by "
+                f"2*ring={2 * mesh.shape[AXIS_SHARD]}; got T={T} "
+                f"(set zigzag=None for auto fallback)")
+        return cfg.zigzag
 
     def dense_init(rng, shape):
         return jax.random.normal(rng, shape) * (1.0 / np.sqrt(shape[0]))
@@ -205,16 +231,31 @@ def build_model(cfg: LongContextConfig) -> Model:
         v = jnp.var(x, -1, keepdims=True)
         return (x - m) * jax.lax.rsqrt(v + 1e-6) * s + b
 
+    tp_mode = cfg.parallelism == "tensor"
+    tp_sp = tp_mode and cfg.tp_sequence_parallel
+
     def attention(x, p):
         B, T, _ = x.shape
-        qkv = x @ p["wqkv"].astype(dt)
+        if tp_mode:
+            # Megatron column-parallel qkv: each device computes its
+            # H/tp heads' projections and runs the attention core
+            # locally; the constraints pin the head sharding so GSPMD
+            # never gathers the scores.
+            qkv = tp_ops.column_parallel(x, p["wqkv"].astype(dt))
+        else:
+            qkv = x @ p["wqkv"].astype(dt)
         q, k, v = jnp.split(qkv, 3, -1)
         q = q.reshape(B, T, Hn, D // Hn)
         k = k.reshape(B, T, Hn, D // Hn)
         v = v.reshape(B, T, Hn, D // Hn)
         mesh = emb_ops.current_mesh()
+        if tp_mode:
+            head = P(AXIS_REPL, None, AXIS_SHARD, None)
+            q = tp_ops.constrain(q, head)
+            k = tp_ops.constrain(k, head)
+            v = tp_ops.constrain(v, head)
         if cfg.use_ring_attention and mesh is not None:
-            placement = ("zigzag" if _zigzag_active(mesh)
+            placement = ("zigzag" if _zigzag_active(mesh, T)
                          else "contiguous")
             out = ring_attention(q, k, v, mesh, AXIS_SHARD,
                                  causal=True, batch_axis=AXIS_REPL,
@@ -224,14 +265,27 @@ def build_model(cfg: LongContextConfig) -> Model:
             out = flash_attention(q, k, v, causal=True)
         else:
             out = full_attention_reference(q, k, v, causal=True)
-        return out.reshape(B, T, D) @ p["wo"].astype(dt)
+        merged = out.reshape(B, T, D)
+        if tp_mode:
+            merged = tp_ops.constrain(
+                merged, P(AXIS_REPL, None, AXIS_SHARD))
+            return tp_ops.row_parallel(merged, p["wo"].astype(dt),
+                                       sequence_parallel=tp_sp)
+        return merged @ p["wo"].astype(dt)
 
     def _block_apply(p, x):
         ln = p["ln1"]
         x = x + attention(
             layer_norm(x, ln["s"].astype(dt), ln["b"].astype(dt)), p)
+        if tp_sp:
+            x = tp_ops.seq_shard(x)
         ln = p["ln2"]
         h = layer_norm(x, ln["s"].astype(dt), ln["b"].astype(dt))
+        if tp_mode:
+            x = x + tp_ops.tp_mlp(h, p["w1"].astype(dt),
+                                  p["w2"].astype(dt),
+                                  sequence_parallel=tp_sp)
+            return tp_ops.seq_shard(x) if tp_sp else x
         return x + (jax.nn.relu(h @ p["w1"].astype(dt))
                     @ p["w2"].astype(dt))
 
@@ -245,7 +299,7 @@ def build_model(cfg: LongContextConfig) -> Model:
             raise ValueError(
                 f"sequence length {T} exceeds max_len {cfg.max_len}")
         mesh = emb_ops.current_mesh()
-        zig = _zigzag_active(mesh)
+        zig = _zigzag_active(mesh, T)
         if zig:
             # Zig-zag placement happens IN-GRAPH: the user (every host)
             # feeds natural-order ids and this static gather moves each
@@ -390,10 +444,8 @@ def build_model(cfg: LongContextConfig) -> Model:
             dense_params=("emb", "pos"),
             batch_specs={"ids": P(AXIS_REPL, None)},
             param_specs={
-                "blocks/*/wqkv": P(None, AXIS_SHARD),
-                "blocks/*/w1": P(None, AXIS_SHARD),
-                "blocks/*/wo": P(AXIS_SHARD, None),
-                "blocks/*/w2": P(AXIS_SHARD, None),
+                **tp_ops.attention_param_specs("blocks/*"),
+                **tp_ops.mlp_param_specs("blocks/*"),
             })
     if cfg.parallelism == "ring":
         # dp over 'repl', sp over 'shard': [batch, seq] inputs

@@ -19,9 +19,11 @@ path) expressed in MXU-shaped matmuls:
   * post-LN transformer blocks under `jax.checkpoint`-friendly static
     shapes; bf16 compute, f32 params;
   * label-smoothed cross-entropy over the target vocab;
-  * decoding re-runs the full causal decoder per emitted token inside a
-    `lax.fori_loop` over static shapes (no KV cache yet — ROADMAP), so
-    the whole decode is one compiled program.
+  * decoding (greedy and beam) is one compiled `lax.fori_loop` over
+    static shapes with per-layer K/V caches — O(T) per emitted token;
+    the cache-less O(T²) loop is kept as the parity reference
+    (``use_cache=False``). File-based vocab/corpus loading lives in
+    data/nmt_data.py (reference: examples/nmt/utils/).
 """
 
 from __future__ import annotations
@@ -36,6 +38,7 @@ import optax
 
 from parallax_tpu.core.engine import Model
 from parallax_tpu.ops import embedding as emb_ops
+from parallax_tpu.ops import tensor_parallel as tp_ops
 
 PAD_ID, BOS_ID, EOS_ID = 0, 1, 2
 
@@ -55,6 +58,12 @@ class NMTConfig:
     # fuse all three attention types (enc self w/ pad mask, causal dec
     # self, cross w/ src pad mask) with the Pallas flash kernels
     use_pallas_attention: bool = False
+    # Megatron tensor parallelism over the 'shard' mesh axis
+    # (ops/tensor_parallel.py): every attention (self, cross) runs
+    # column-parallel q/k/v + head-sharded core + row-parallel out-proj;
+    # the MLP runs column-parallel up / row-parallel down. Composes with
+    # the row-sharded shared embedding on the same axis.
+    tensor_parallel: bool = False
     num_partitions: Optional[int] = None
     compute_dtype: jnp.dtype = jnp.bfloat16
 
@@ -135,19 +144,32 @@ def _attend(cfg, dt, x_q, x_kv, w, *, causal=False, kv_mask=None):
 
 def _self_block(cfg, dt, p, x, cross_kv=None, *, self_causal=False,
                 self_kv_mask=None, cross_kv_mask=None):
+    tp = cfg.tensor_parallel
+
+    def attn_out(x_q, x_kv, w, causal, kv_mask):
+        """Attention + output projection (row-parallel under TP)."""
+        if tp:
+            return tp_ops.tp_attention(x_q, x_kv, w, cfg.num_heads,
+                                       causal=causal, kv_mask=kv_mask,
+                                       dtype=dt)
+        return _attend(cfg, dt, x_q, x_kv, w, causal=causal,
+                       kv_mask=kv_mask) @ w["wo"].astype(dt)
+
     a = p["attn"]
-    y = _attend(cfg, dt, x, x, a, causal=self_causal,
-                kv_mask=self_kv_mask)
-    x = _layer_norm(x + y @ a["wo"].astype(dt),
+    y = attn_out(x, x, a, self_causal, self_kv_mask)
+    x = _layer_norm(x + y,
                     p["ln1"]["s"].astype(dt), p["ln1"]["b"].astype(dt))
     if cross_kv is not None:
         c = p["cross"]
-        y = _attend(cfg, dt, x, cross_kv, c, kv_mask=cross_kv_mask)
-        x = _layer_norm(x + y @ c["wo"].astype(dt),
+        y = attn_out(x, cross_kv, c, False, cross_kv_mask)
+        x = _layer_norm(x + y,
                         p["ln3"]["s"].astype(dt),
                         p["ln3"]["b"].astype(dt))
     m = p["mlp"]
-    y = jax.nn.relu(x @ m["w1"].astype(dt)) @ m["w2"].astype(dt)
+    if tp:
+        y = tp_ops.tp_mlp(x, m["w1"], m["w2"], dtype=dt)
+    else:
+        y = jax.nn.relu(x @ m["w1"].astype(dt)) @ m["w2"].astype(dt)
     return _layer_norm(x + y, p["ln2"]["s"].astype(dt),
                        p["ln2"]["b"].astype(dt))
 
@@ -157,8 +179,10 @@ def _encode(cfg, params, src):
     dt = cfg.compute_dtype
     Ts = src.shape[1]
     pos = params["pos"].astype(dt)
+    # dt-typed scale: a bare numpy scalar is strongly float32-typed and
+    # would silently promote the whole bf16 stack to fp32
     x = (emb_ops.embedding_lookup(params["emb"], src).astype(dt)
-         * np.sqrt(cfg.model_dim) + pos[None, :Ts])
+         * jnp.asarray(np.sqrt(cfg.model_dim), dt) + pos[None, :Ts])
     src_valid = (src > PAD_ID)
     for p in params["enc"]:
         x = _self_block(cfg, dt, p, x, self_kv_mask=src_valid)
@@ -171,7 +195,7 @@ def _decode_hidden(cfg, params, tgt_in, enc_out, src_valid):
     Tt = tgt_in.shape[1]
     pos = params["pos"].astype(dt)
     x = (emb_ops.embedding_lookup(params["emb"], tgt_in).astype(dt)
-         * np.sqrt(cfg.model_dim) + pos[None, :Tt])
+         * jnp.asarray(np.sqrt(cfg.model_dim), dt) + pos[None, :Tt])
     for p in params["dec"]:
         x = _self_block(cfg, dt, p, x, cross_kv=enc_out,
                         self_causal=True, cross_kv_mask=src_valid)
@@ -194,6 +218,71 @@ def _decode_step_logits(cfg, params, tgt_in, enc_out, src_valid, t):
     h_t = jax.lax.dynamic_index_in_dim(x, t, axis=1, keepdims=False)
     logits = h_t.astype(jnp.float32) @ params["out_proj"]
     return emb_ops.mask_padded_logits(logits, cfg.vocab_size)
+
+
+# ----- KV-cached incremental decoding -------------------------------------
+# The cache-less loop above re-runs the causal decoder over the whole
+# buffer per emitted token (O(T²) per token); the cached path computes
+# each new token's layer inputs once and attends against stored K/V —
+# O(T) per token, the standard transformer inference shape. Both paths
+# produce the same tokens (tested: tests/test_nmt_data.py).
+
+
+def _cross_kv(cfg, params, enc_out):
+    """Per-layer cross-attention K/V, computed ONCE per decode:
+    [L, B, Ts, D] stacks."""
+    dt = cfg.compute_dtype
+    ks, vs = [], []
+    for p in params["dec"]:
+        c = p["cross"]
+        ks.append(enc_out @ c["wk"].astype(dt))
+        vs.append(enc_out @ c["wv"].astype(dt))
+    return jnp.stack(ks), jnp.stack(vs)
+
+
+def _init_self_cache(cfg, batch: int, max_len: int):
+    L, D = cfg.num_layers, cfg.model_dim
+    z = jnp.zeros((L, batch, max_len, D), cfg.compute_dtype)
+    return z, z
+
+
+def _decode_step_cached(cfg, params, tok, t, kc, vc, ck, cv, src_valid):
+    """One cached decoder step: ``tok`` [B] is the token at position
+    ``t``; writes its K/V into the caches and returns (logits [B, V],
+    new kc, new vc). Math identical to slot t of the cache-less decoder
+    (same post-LN blocks, same masks) — only the cost changes."""
+    dt = cfg.compute_dtype
+    D = cfg.model_dim
+    T = kc.shape[2]
+    pos_t = jax.lax.dynamic_index_in_dim(params["pos"].astype(dt), t,
+                                         axis=0, keepdims=True)  # [1, D]
+    x = (emb_ops.embedding_lookup(params["emb"], tok[:, None]).astype(dt)
+         * jnp.asarray(np.sqrt(D), dt) + pos_t[None])          # [B, 1, D]
+    self_mask = None  # built once; same for every layer
+    for i, p in enumerate(params["dec"]):
+        a = p["attn"]
+        q = x @ a["wq"].astype(dt)
+        k_t = x @ a["wk"].astype(dt)
+        v_t = x @ a["wv"].astype(dt)
+        kc = jax.lax.dynamic_update_slice(kc, k_t[None], (i, 0, t, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v_t[None], (i, 0, t, 0))
+        if self_mask is None:
+            self_mask = (jnp.arange(T) <= t)[None, None, None, :]
+        y = _attention(q, kc[i], vc[i], self_mask, cfg.num_heads)
+        x = _layer_norm(x + y @ a["wo"].astype(dt),
+                        p["ln1"]["s"].astype(dt), p["ln1"]["b"].astype(dt))
+        c = p["cross"]
+        qc = x @ c["wq"].astype(dt)
+        yc = _attention(qc, ck[i], cv[i], src_valid[:, None, None, :],
+                        cfg.num_heads)
+        x = _layer_norm(x + yc @ c["wo"].astype(dt),
+                        p["ln3"]["s"].astype(dt), p["ln3"]["b"].astype(dt))
+        m = p["mlp"]
+        y2 = jax.nn.relu(x @ m["w1"].astype(dt)) @ m["w2"].astype(dt)
+        x = _layer_norm(x + y2,
+                        p["ln2"]["s"].astype(dt), p["ln2"]["b"].astype(dt))
+    logits = x[:, 0].astype(jnp.float32) @ params["out_proj"]
+    return emb_ops.mask_padded_logits(logits, cfg.vocab_size), kc, vc
 
 
 def build_model(cfg: NMTConfig) -> Model:
@@ -264,7 +353,21 @@ def build_model(cfg: NMTConfig) -> Model:
          optax.constant_schedule(cfg.learning_rate)],
         [cfg.warmup_steps])
     tx = optax.chain(optax.clip_by_global_norm(5.0), optax.adam(sched))
-    return Model(init_fn, loss_fn, optimizer=tx)
+    specs, bspecs = {}, {}
+    if cfg.tensor_parallel:
+        for stack in ("enc", "dec"):
+            specs.update(tp_ops.attention_param_specs(
+                f"{stack}/*/attn", fused_qkv=False))
+            specs.update(tp_ops.attention_param_specs(
+                f"{stack}/*/cross", fused_qkv=False))
+            specs.update(tp_ops.mlp_param_specs(f"{stack}/*/mlp"))
+        # batch rides 'repl' only — 'shard' is the TP axis
+        from jax.sharding import PartitionSpec as P
+        from parallax_tpu.core.mesh import AXIS_REPL
+        bspecs = {k: P(AXIS_REPL, None)
+                  for k in ("src", "tgt_in", "tgt_out", "w")}
+    return Model(init_fn, loss_fn, optimizer=tx, param_specs=specs,
+                 batch_specs=bspecs)
 
 
 # --------------------------------------------------------------------------
@@ -273,16 +376,38 @@ def build_model(cfg: NMTConfig) -> Model:
 # --------------------------------------------------------------------------
 
 
-def greedy_decode(params, cfg: NMTConfig, src, max_len: Optional[int] = None):
+def greedy_decode(params, cfg: NMTConfig, src,
+                  max_len: Optional[int] = None, use_cache: bool = True):
     """Greedy decode; returns int32 [B, max_len] (PAD after EOS, EOS
-    included). Jittable end-to-end: one fori_loop re-running the causal
-    decoder on the static [B, max_len] buffer each step."""
+    included). Jittable end-to-end: one fori_loop over the static
+    [B, max_len] buffer. ``use_cache`` (default) decodes incrementally
+    against per-layer K/V caches — O(T) per token; ``use_cache=False``
+    keeps the cache-less reference loop (O(T²) per token, used for the
+    parity test)."""
     T = int(max_len or cfg.max_len)
     src = jnp.asarray(src, jnp.int32)
     B = src.shape[0]
     enc_out, src_valid = _encode(cfg, params, src)
     tgt = jnp.full((B, T + 1), PAD_ID, jnp.int32).at[:, 0].set(BOS_ID)
     done = jnp.zeros((B,), bool)
+
+    if use_cache:
+        ck, cv = _cross_kv(cfg, params, enc_out)
+        kc, vc = _init_self_cache(cfg, B, T)
+
+        def body(t, carry):
+            tgt, done, kc, vc = carry
+            tok = jax.lax.dynamic_index_in_dim(tgt, t, axis=1,
+                                               keepdims=False)
+            logits, kc, vc = _decode_step_cached(
+                cfg, params, tok, t, kc, vc, ck, cv, src_valid)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(done, PAD_ID, nxt)
+            tgt = jax.lax.dynamic_update_index_in_dim(tgt, nxt, t + 1, 1)
+            return tgt, done | (nxt == EOS_ID), kc, vc
+
+        tgt, *_ = jax.lax.fori_loop(0, T, body, (tgt, done, kc, vc))
+        return tgt[:, 1:]
 
     def body(t, carry):
         tgt, done = carry
@@ -303,9 +428,12 @@ def _length_penalty(length, alpha):
 
 
 def beam_decode(params, cfg: NMTConfig, src, beam_width: int = 4,
-                alpha: float = 1.0, max_len: Optional[int] = None):
+                alpha: float = 1.0, max_len: Optional[int] = None,
+                use_cache: bool = True):
     """Beam search with the GNMT length penalty; returns the best
-    hypothesis per example, int32 [B, max_len]."""
+    hypothesis per example, int32 [B, max_len]. ``use_cache`` decodes
+    against per-layer K/V caches, reordered by the winning parent beams
+    each step alongside the rest of the carried state."""
     T = int(max_len or cfg.max_len)
     K = int(beam_width)
     src = jnp.asarray(src, jnp.int32)
@@ -324,32 +452,71 @@ def beam_decode(params, cfg: NMTConfig, src, beam_width: int = 4,
     done = jnp.zeros((B, K), bool)
     lengths = jnp.zeros((B, K), jnp.float32)
 
-    def body(t, carry):
-        tgt, logp, done, lengths = carry
-        logits = _decode_step_logits(cfg, params,
-                                     tgt.reshape(B * K, T + 1)[:, :-1],
-                                     enc_k, valid_k, t)
-        step_logp = jax.nn.log_softmax(logits).reshape(B, K, V)
-        # finished beams may only emit PAD, at no cost
-        pad_only = jnp.full((V,), NEG).at[PAD_ID].set(0.0)
-        step_logp = jnp.where(done[:, :, None], pad_only[None, None],
-                              step_logp)
-        cand = logp[:, :, None] + step_logp              # [B, K, V]
-        flat = cand.reshape(B, K * V)
-        top_logp, top_idx = jax.lax.top_k(flat, K)       # [B, K]
-        beam_idx = top_idx // V
-        tok = (top_idx % V).astype(jnp.int32)
-        # reorder carried state by the winning parent beams
-        tgt = jnp.take_along_axis(tgt, beam_idx[:, :, None], axis=1)
-        done = jnp.take_along_axis(done, beam_idx, axis=1)
-        lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
-        tgt = jax.lax.dynamic_update_index_in_dim(tgt, tok, t + 1, 2)
-        lengths = jnp.where(done, lengths, lengths + 1.0)
-        done = done | (tok == EOS_ID)
-        return tgt, top_logp, done, lengths
+    if use_cache:
+        ck, cv = _cross_kv(cfg, params, enc_k)
+        kc0, vc0 = _init_self_cache(cfg, B * K, T)
 
-    tgt, logp, done, lengths = jax.lax.fori_loop(
-        0, T, body, (tgt, logp, done, lengths))
+        def reorder_cache(c, beam_idx):
+            L, _, _, D = c.shape
+            c = c.reshape(L, B, K, T, D)
+            c = jnp.take_along_axis(
+                c, beam_idx[None, :, :, None, None], axis=2)
+            return c.reshape(L, B * K, T, D)
+
+        def body(t, carry):
+            tgt, logp, done, lengths, kc, vc = carry
+            tok_in = jax.lax.dynamic_index_in_dim(
+                tgt.reshape(B * K, T + 1), t, axis=1, keepdims=False)
+            logits, kc, vc = _decode_step_cached(
+                cfg, params, tok_in, t, kc, vc, ck, cv, valid_k)
+            step_logp = jax.nn.log_softmax(logits).reshape(B, K, V)
+            pad_only = jnp.full((V,), NEG).at[PAD_ID].set(0.0)
+            step_logp = jnp.where(done[:, :, None], pad_only[None, None],
+                                  step_logp)
+            cand = logp[:, :, None] + step_logp
+            flat = cand.reshape(B, K * V)
+            top_logp, top_idx = jax.lax.top_k(flat, K)
+            beam_idx = top_idx // V
+            tok = (top_idx % V).astype(jnp.int32)
+            tgt = jnp.take_along_axis(tgt, beam_idx[:, :, None], axis=1)
+            done = jnp.take_along_axis(done, beam_idx, axis=1)
+            lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+            kc = reorder_cache(kc, beam_idx)
+            vc = reorder_cache(vc, beam_idx)
+            tgt = jax.lax.dynamic_update_index_in_dim(tgt, tok, t + 1, 2)
+            lengths = jnp.where(done, lengths, lengths + 1.0)
+            done = done | (tok == EOS_ID)
+            return tgt, top_logp, done, lengths, kc, vc
+
+        tgt, logp, done, lengths, *_ = jax.lax.fori_loop(
+            0, T, body, (tgt, logp, done, lengths, kc0, vc0))
+    else:
+        def body(t, carry):
+            tgt, logp, done, lengths = carry
+            logits = _decode_step_logits(
+                cfg, params, tgt.reshape(B * K, T + 1)[:, :-1],
+                enc_k, valid_k, t)
+            step_logp = jax.nn.log_softmax(logits).reshape(B, K, V)
+            # finished beams may only emit PAD, at no cost
+            pad_only = jnp.full((V,), NEG).at[PAD_ID].set(0.0)
+            step_logp = jnp.where(done[:, :, None], pad_only[None, None],
+                                  step_logp)
+            cand = logp[:, :, None] + step_logp          # [B, K, V]
+            flat = cand.reshape(B, K * V)
+            top_logp, top_idx = jax.lax.top_k(flat, K)   # [B, K]
+            beam_idx = top_idx // V
+            tok = (top_idx % V).astype(jnp.int32)
+            # reorder carried state by the winning parent beams
+            tgt = jnp.take_along_axis(tgt, beam_idx[:, :, None], axis=1)
+            done = jnp.take_along_axis(done, beam_idx, axis=1)
+            lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+            tgt = jax.lax.dynamic_update_index_in_dim(tgt, tok, t + 1, 2)
+            lengths = jnp.where(done, lengths, lengths + 1.0)
+            done = done | (tok == EOS_ID)
+            return tgt, top_logp, done, lengths
+
+        tgt, logp, done, lengths = jax.lax.fori_loop(
+            0, T, body, (tgt, logp, done, lengths))
     # Only finished hypotheses are length-normalized candidates
     # (reference inference keeps finished beams); unfinished beams are
     # pushed below every finished one but keep their relative order, so

@@ -247,7 +247,8 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
         ctx.records.append((tuple(table.shape), n_eff, n_cnt,
                             _cross_replica_bytes(
                                 ctx.mesh, table.shape, cap_eff,
-                                has_counts, sparse_repl, elem)))
+                                has_counts, sparse_repl, elem),
+                            sparse_repl, elem))
     if ctx.average_duplicates or sparse_repl:
         rows = _sharded_lookup_manual(table, ids, ctx.mesh, cap, guarded,
                                       ctx.average_duplicates, sparse_repl)

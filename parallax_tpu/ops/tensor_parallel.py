@@ -1,0 +1,245 @@
+"""Megatron-style tensor parallelism: column/row-parallel kernels.
+
+Reference parity: the closest thing the reference has to model
+parallelism is embedding-row sharding over PS tasks (reference:
+core/python/ps/between_graph_parallel.py:49-70, SURVEY §2.5). This
+module provides the real thing, TPU-style: weights carry PartitionSpecs
+(column-parallel kernels split their OUTPUT features over the 'shard'
+mesh axis, row-parallel kernels their INPUT features), activations carry
+`with_sharding_constraint` pins at the Megatron cut points, and
+XLA/GSPMD partitions the matmuls onto per-device MXUs and inserts the
+f/g collectives itself — one all-reduce after the attention output
+projection and one after the MLP down projection, exactly Megatron's
+two-AR-per-block forward pattern, without a single hand-written
+collective.
+
+Sequence-parallel composition (Megatron-LM sequence parallelism, the
+TP×SP pattern): with ``sequence_parallel=True`` the block's OUTPUT is
+pinned sequence-sharded over the same 'shard' axis instead of fully
+replicated, so XLA turns the closing all-reduce into a reduce-scatter
+and re-gathers (all-gather) only at the next block's qkv/up-proj entry —
+the norm/residual region between blocks then holds only T/tp of every
+activation. Same mesh, same two axes the engine already builds
+(core/mesh.py), no third axis needed.
+
+Every function is a numeric no-op when no mesh is installed or the
+'shard' axis is 1, so a model can call these unconditionally: the
+data-parallel trace and the tensor-parallel trace run the SAME math,
+which is what the trajectory-parity tests assert.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD
+from parallax_tpu.ops import embedding as emb_ops
+
+
+def _tp_size(mesh: Optional[Mesh], tp_axis: str) -> int:
+    if mesh is None or tp_axis not in mesh.shape:
+        return 1
+    return mesh.shape[tp_axis]
+
+
+def _active_mesh(mesh: Optional[Mesh], tp_axis: str) -> Optional[Mesh]:
+    mesh = mesh if mesh is not None else emb_ops.current_mesh()
+    return mesh if _tp_size(mesh, tp_axis) > 1 else None
+
+
+def constrain(x: jax.Array, spec: P,
+              mesh: Optional[Mesh] = None,
+              tp_axis: str = AXIS_SHARD) -> jax.Array:
+    """`with_sharding_constraint` against the engine's current mesh;
+    identity when tracing outside parallel_run or with a 1-wide shard
+    axis (single-device tests, pure-DP runs)."""
+    mesh = _active_mesh(mesh, tp_axis)
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec))
+
+
+def _feat_spec(ndim: int, batch_axis, tp_axis) -> P:
+    """[batch, ..., features] with features TP-sharded."""
+    return P(batch_axis, *([None] * (ndim - 2)), tp_axis)
+
+
+def _full_spec(ndim: int, batch_axis) -> P:
+    return P(batch_axis, *([None] * (ndim - 1)))
+
+
+def _seq_spec(ndim: int, batch_axis, tp_axis) -> P:
+    """[batch, seq, ...] with seq TP-sharded (sequence-parallel region)."""
+    return P(batch_axis, tp_axis, *([None] * (ndim - 2)))
+
+
+def column_parallel(x: jax.Array, w: jax.Array, *,
+                    mesh: Optional[Mesh] = None,
+                    tp_axis: str = AXIS_SHARD,
+                    batch_axis=AXIS_REPL) -> jax.Array:
+    """``x @ w`` with ``w`` column-sharded [D, F/tp]: output features
+    arrive TP-sharded, no communication in the forward pass (Megatron's
+    f operator is the identity forward / all-reduce backward — GSPMD
+    inserts the backward psum from the replicated-x sharding)."""
+    y = x @ w
+    return constrain(y, _feat_spec(y.ndim, batch_axis, tp_axis),
+                     mesh, tp_axis)
+
+
+def row_parallel(x: jax.Array, w: jax.Array, *,
+                 mesh: Optional[Mesh] = None,
+                 tp_axis: str = AXIS_SHARD,
+                 batch_axis=AXIS_REPL,
+                 sequence_parallel: bool = False) -> jax.Array:
+    """``x @ w`` with ``x`` feature-sharded and ``w`` row-sharded
+    [F/tp, D]: each device contracts its feature slice and the pinned
+    output sharding makes GSPMD insert the combining collective —
+    all-reduce (g operator) normally, reduce-scatter over the sequence
+    dim when ``sequence_parallel`` (the TP×SP composition)."""
+    y = x @ w
+    spec = (_seq_spec(y.ndim, batch_axis, tp_axis) if sequence_parallel
+            else _full_spec(y.ndim, batch_axis))
+    return constrain(y, spec, mesh, tp_axis)
+
+
+def tp_attention(x_q: jax.Array, x_kv: jax.Array, w: Dict[str, jax.Array],
+                 num_heads: int, *,
+                 causal: bool = False,
+                 kv_mask: Optional[jax.Array] = None,
+                 dtype: Optional[jnp.dtype] = None,
+                 mesh: Optional[Mesh] = None,
+                 tp_axis: str = AXIS_SHARD,
+                 batch_axis=AXIS_REPL,
+                 sequence_parallel: bool = False) -> jax.Array:
+    """Head-sharded multi-head attention, [B, Tq, D] -> [B, Tq, D].
+
+    ``w`` holds either a fused ``wqkv`` [D, 3D] or separate
+    ``wq``/``wk``/``wv`` [D, D] (cross-attention passes ``x_kv`` !=
+    ``x_q``), plus the output projection ``wo`` [D, D]. Projections are
+    column-parallel (each device holds H/tp heads and runs its attention
+    core entirely locally — scores and softmax never cross ICI), the
+    output projection is row-parallel. Math matches the models' shared
+    scaled-dot-product formula (fp32 softmax, -1e9 masking) so the DP
+    and TP traces are the same function.
+    """
+    cast = (lambda a: a.astype(dtype)) if dtype is not None else (
+        lambda a: a)
+    B, Tq, D = x_q.shape
+    Tk = x_kv.shape[1]
+    hd = D // num_heads
+
+    if "wqkv" in w:
+        qkv = column_parallel(x_q, cast(w["wqkv"]), mesh=mesh,
+                              tp_axis=tp_axis, batch_axis=batch_axis)
+        q, k, v = jnp.split(qkv, 3, -1)
+    else:
+        q = column_parallel(x_q, cast(w["wq"]), mesh=mesh,
+                            tp_axis=tp_axis, batch_axis=batch_axis)
+        k = column_parallel(x_kv, cast(w["wk"]), mesh=mesh,
+                            tp_axis=tp_axis, batch_axis=batch_axis)
+        v = column_parallel(x_kv, cast(w["wv"]), mesh=mesh,
+                            tp_axis=tp_axis, batch_axis=batch_axis)
+
+    head_spec = P(batch_axis, None, tp_axis, None)
+
+    def heads(z, T):
+        z = constrain(z.reshape(B, T, num_heads, hd), head_spec,
+                      mesh, tp_axis)
+        return z.transpose(0, 2, 1, 3)                    # [B, H, T, hd]
+
+    qh, kh, vh = heads(q, Tq), heads(k, Tk), heads(v, Tk)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.sqrt(hd)
+    scores = constrain(scores, P(batch_axis, tp_axis, None, None),
+                       mesh, tp_axis)
+    mask = None
+    if kv_mask is not None:
+        mask = kv_mask[:, None, None, :]                  # [B, 1, 1, Tk]
+    if causal:
+        tri = jnp.tril(jnp.ones((Tq, Tk), bool))[None, None]
+        mask = tri if mask is None else (mask & tri)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(qh.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)        # [B, H, Tq, hd]
+    merged = out.transpose(0, 2, 1, 3).reshape(B, Tq, D)
+    merged = constrain(merged, _feat_spec(3, batch_axis, tp_axis),
+                       mesh, tp_axis)
+    return row_parallel(merged, cast(w["wo"]), mesh=mesh,
+                        tp_axis=tp_axis, batch_axis=batch_axis,
+                        sequence_parallel=sequence_parallel)
+
+
+def tp_mlp(x: jax.Array, w1: jax.Array, w2: jax.Array, *,
+           act=jax.nn.relu,
+           dtype: Optional[jnp.dtype] = None,
+           mesh: Optional[Mesh] = None,
+           tp_axis: str = AXIS_SHARD,
+           batch_axis=AXIS_REPL,
+           sequence_parallel: bool = False) -> jax.Array:
+    """Column-parallel up projection [D, M/tp], elementwise activation on
+    the local feature slice, row-parallel down projection [M/tp, D]."""
+    cast = (lambda a: a.astype(dtype)) if dtype is not None else (
+        lambda a: a)
+    h = act(column_parallel(x, cast(w1), mesh=mesh, tp_axis=tp_axis,
+                            batch_axis=batch_axis))
+    return row_parallel(h, cast(w2), mesh=mesh, tp_axis=tp_axis,
+                        batch_axis=batch_axis,
+                        sequence_parallel=sequence_parallel)
+
+
+def seq_shard(x: jax.Array, *, mesh: Optional[Mesh] = None,
+              tp_axis: str = AXIS_SHARD,
+              batch_axis=AXIS_REPL) -> jax.Array:
+    """Pin a [B, T, ...] activation sequence-sharded over the TP axis —
+    the between-block resting sharding of the TP×SP composition (norms,
+    residual adds and dropout then touch only T/tp rows per device)."""
+    return constrain(x, _seq_spec(x.ndim, batch_axis, tp_axis),
+                     mesh, tp_axis)
+
+
+# -------------------------------------------------------------------------
+# param_specs helpers: the PartitionSpec overrides a Model declares so the
+# engine's sharding plan (core/engine.py:build_plan) places TP weights.
+# -------------------------------------------------------------------------
+
+
+def attention_param_specs(prefix: str,
+                          tp_axis: str = AXIS_SHARD,
+                          fused_qkv: bool = True) -> Dict[str, P]:
+    """Overrides for one attention's weights under ``prefix`` (fnmatch
+    pattern, e.g. "blocks/*" or "enc/*/attn")."""
+    col = P(None, tp_axis)
+    row = P(tp_axis, None)
+    if fused_qkv:
+        return {f"{prefix}/wqkv": col, f"{prefix}/wo": row}
+    return {f"{prefix}/wq": col, f"{prefix}/wk": col,
+            f"{prefix}/wv": col, f"{prefix}/wo": row}
+
+
+def mlp_param_specs(prefix: str,
+                    tp_axis: str = AXIS_SHARD) -> Dict[str, P]:
+    return {f"{prefix}/w1": P(None, tp_axis),
+            f"{prefix}/w2": P(tp_axis, None)}
+
+
+def count_collectives(fn, *example_args) -> Dict[str, int]:
+    """Compile ``fn`` and count collective ops in the optimized HLO —
+    the test hook that pins the Megatron communication pattern (e.g.
+    exactly one all-reduce per block forward, reduce-scatter appearing
+    only in the sequence-parallel composition)."""
+    text = jax.jit(fn).lower(*example_args).compile().as_text()
+    return {
+        "all_reduce": text.count(" all-reduce("),
+        "all_gather": text.count(" all-gather("),
+        "reduce_scatter": text.count(" reduce-scatter("),
+        "all_to_all": text.count(" all-to-all("),
+        "collective_permute": text.count(" collective-permute("),
+    }

@@ -171,7 +171,7 @@ class TestLocalAggregationDedup:
             with self._scope(4, False, local_agg, records=records):
                 jax.jit(lambda t:
                         embedding.embedding_lookup(t, ids))(table)
-            (_, n_eff, _, _), = records
+            (_, n_eff, *_), = records
             counts[local_agg] = n_eff
         assert counts[False] == self.SB
         # capacity min(local ids 16, vocab+1 = 9) = 9 slots x 8 devices
@@ -223,7 +223,7 @@ class TestLocalAggregationDedup:
                                             records=records,
                                             local_aggregation=True):
             jax.jit(lambda t: embedding.embedding_lookup(t, ids))(table)
-        (_, n_eff, _, _), = records
+        (_, n_eff, *_), = records
         assert n_eff == B
 
 
@@ -283,7 +283,7 @@ class TestDeclaredDedupCapacity:
             with self._scope(False, cap, records=records):
                 jax.jit(lambda t:
                         embedding.embedding_lookup(t, ids))(table)
-            (_, n_eff, _, _), = records
+            (_, n_eff, *_), = records
             counts[cap] = n_eff
         # automatic bound min(16, 65) = 16 = per-device ids: no win
         assert counts[None] == self.CB
@@ -355,7 +355,7 @@ class TestSparseCrossReplicaCombine:
             with self._scope(vocab, False, xrepl, records=records):
                 jax.jit(lambda t:
                         embedding.embedding_lookup(t, ids))(table)
-            (_, _, _, rb), = records
+            (_, _, _, rb, *_), = records
             repl_bytes[xrepl] = rb
         assert repl_bytes[False] > 0  # dense psum cost visible
         assert repl_bytes[True] > 0

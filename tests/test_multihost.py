@@ -142,3 +142,63 @@ def test_two_process_launch_and_training(tmp_path):
     w1 = dict(kv.split("=") for kv in results[1].split())
     assert w0["w"] == w1["w"] and w0["b"] == w1["b"], (results[0],
                                                       results[1])
+
+
+@pytest.mark.slow
+def test_two_process_sparse_cross_replica_combine(tmp_path):
+    """Multi-slice sparse combine across a process boundary (VERDICT r3
+    item 4): the 2-process x 4-device mesh must nest each shard ring
+    inside one process (asserted in the driver), auto-pick the SPARSE
+    cross-replica table-grad combine (asserted in the driver), and its
+    trajectory must match a single-host run FORCED to the dense psum
+    combine on the same global batches."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out = str(tmp_path / "sparse")
+    env = dict(os.environ)
+    env.update({
+        "PARALLAX_COORDINATOR_PORT": str(port),
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": os.getcwd() + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.pop("PARALLAX_RUN_OPTION", None)
+    proc = subprocess.run(
+        [sys.executable, "tests/multihost_sparse_driver.py", out],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+
+    losses = {}
+    for wid in (0, 1):
+        path = f"{out}.worker{wid}"
+        assert os.path.exists(path), proc.stderr[-2000:]
+        losses[wid] = [float(x) for x in open(path).read().split()]
+    assert losses[0] == losses[1], "workers disagree on the loss"
+
+    # single-host reference on the same global batches, dense combine
+    import numpy as np
+    import parallax_tpu as parallax
+    from tests import multihost_sparse_driver as drv
+    from parallax_tpu.models import lm1b
+    cfg = lm1b.tiny_config(num_partitions=drv.NUM_PARTITIONS)
+    comm = parallax.CommunicationConfig(
+        ps_config=parallax.PSConfig(cross_replica_sparse=False))
+    sess, *_ = parallax.parallel_run(
+        lm1b.build_model(cfg),
+        parallax_config=parallax.Config(run_option="HYBRID",
+                                        search_partitions=False,
+                                        communication_config=comm),
+        num_partitions=drv.NUM_PARTITIONS)
+    sess.run([], feed_dict=lm1b.make_batch(
+        np.random.default_rng(0), drv.B, drv.T, cfg.vocab_size))
+    # the forced hint took: the dense combine is in the trace
+    recs = sess.engine.sparse_wire_bytes_per_step()["per_lookup"]
+    assert recs and not any(r["cross_replica_sparse"] for r in recs), recs
+    ref = []
+    for step in range(1, drv.STEPS):
+        batch = lm1b.make_batch(np.random.default_rng(step), drv.B,
+                                drv.T, cfg.vocab_size)
+        ref.append(float(sess.run("loss", feed_dict=batch)))
+    sess.close()
+    np.testing.assert_allclose(losses[0], ref, rtol=1e-4)

@@ -1,0 +1,161 @@
+"""NMT data utilities + KV-cached decoding (VERDICT r3 item 6).
+
+Reference parity: examples/nmt/utils/vocab_utils.py + iterator_utils.py
+and nmt_test.py:48-79 (testInference-style train->decode->BLEU golden).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import parallax_tpu as parallax
+from parallax_tpu.common.evaluation import corpus_bleu
+from parallax_tpu.data import nmt_data
+from parallax_tpu.models import nmt
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "nmt")
+
+
+def test_vocab_specials_and_unk_roundtrip():
+    v = nmt_data.Vocab.load(os.path.join(DATA, "vocab.txt"))
+    assert v.id_to_token[:4] == ["<pad>", "<s>", "</s>", "<unk>"]
+    assert v.token_to_id["<pad>"] == nmt_data.PAD_ID
+    ids = v.encode("a b zzz j")
+    assert ids[2] == nmt_data.UNK_ID
+    assert v.decode(ids + [nmt_data.EOS_ID, 9]) == ["a", "b", "<unk>", "j"]
+
+    # a vocab file without specials gets them prepended (check_vocab)
+    v2 = nmt_data.Vocab(["x", "y"])
+    assert v2.id_to_token[:4] == ["<pad>", "<s>", "</s>", "<unk>"]
+    assert v2.token_to_id["x"] == 4
+
+
+def test_corpus_loading_and_length_filter(tmp_path):
+    v = nmt_data.Vocab.load(os.path.join(DATA, "vocab.txt"))
+    pairs = nmt_data.load_parallel_corpus(
+        os.path.join(DATA, "train.src"), os.path.join(DATA, "train.tgt"),
+        v, max_len=16)
+    assert len(pairs) == 96
+    for s, t in pairs:
+        assert s == t                      # checked-in corpus: copy task
+        assert 3 <= len(s) <= 8
+    # the length filter drops long pairs
+    short = nmt_data.load_parallel_corpus(
+        os.path.join(DATA, "train.src"), os.path.join(DATA, "train.tgt"),
+        v, max_len=4)
+    assert 0 < len(short) < 96
+    assert all(len(s) <= 4 for s, _ in short)
+
+
+def test_iterator_static_buckets_and_feed_contract():
+    v = nmt_data.Vocab.load(os.path.join(DATA, "vocab.txt"))
+    pairs = nmt_data.load_parallel_corpus(
+        os.path.join(DATA, "train.src"), os.path.join(DATA, "train.tgt"),
+        v, max_len=16)
+    it = nmt_data.NMTBatchIterator(pairs, batch_size=8, max_len=16,
+                                   bucket_width=8)
+    shapes = set()
+    n = 0
+    for batch in it.epoch(0):
+        assert set(batch) == {"src", "tgt_in", "tgt_out", "w"}
+        B, T = batch["src"].shape
+        assert B == 8 and T % 8 == 0 and T <= 16
+        shapes.add(batch["src"].shape)
+        # BOS-prefixed input, EOS-suffixed output, weights cover tgt+EOS
+        assert (batch["tgt_in"][:, 0] == nmt_data.BOS_ID).all()
+        lens = (batch["w"] > 0).sum(axis=1)
+        for r in range(B):
+            L = int(lens[r]) - 1  # minus the EOS slot
+            assert batch["tgt_out"][r, L] == nmt_data.EOS_ID
+            np.testing.assert_array_equal(
+                batch["tgt_in"][r, 1:L + 1], batch["tgt_out"][r, :L])
+        n += 1
+    assert n >= 2
+    # static shapes: only a handful of bucket-bound shapes ever compiled
+    assert len(shapes) <= 2, shapes
+
+
+def test_iterator_sharding_partitions_the_corpus():
+    v = nmt_data.Vocab.load(os.path.join(DATA, "vocab.txt"))
+    pairs = nmt_data.load_parallel_corpus(
+        os.path.join(DATA, "train.src"), os.path.join(DATA, "train.tgt"),
+        v, max_len=16)
+
+    def shard_batches(shard_index):
+        it = nmt_data.NMTBatchIterator(
+            pairs, batch_size=4, max_len=16, num_shards=2,
+            shard_index=shard_index, drop_remainder=False)
+        return list(it.epoch(0))
+
+    b0, b1 = shard_batches(0), shard_batches(1)
+    # SPMD lockstep: same number of steps, same shapes at every step
+    assert len(b0) == len(b1) >= 1
+    for a, b in zip(b0, b1):
+        assert a["src"].shape == b["src"].shape
+        assert a["src"].shape[0] == 2  # batch_size / num_shards rows
+
+    def real_rows(batches):
+        return sum(int(b["w"][r].sum() > 0)
+                   for b in batches for r in range(b["src"].shape[0]))
+
+    # the row stripes partition the corpus exactly
+    assert real_rows(b0) + real_rows(b1) == len(pairs)
+
+
+def test_cached_decode_matches_cacheless(rng):
+    cfg = nmt.tiny_config(compute_dtype=jnp.float32)
+    params = nmt.build_model(cfg).init_fn(jax.random.PRNGKey(0))
+    src = rng.integers(4, cfg.vocab_size, (4, 8)).astype(np.int32)
+
+    g_cached = np.asarray(nmt.greedy_decode(params, cfg, src, max_len=12))
+    g_plain = np.asarray(nmt.greedy_decode(params, cfg, src, max_len=12,
+                                           use_cache=False))
+    np.testing.assert_array_equal(g_cached, g_plain)
+
+    b_cached = np.asarray(nmt.beam_decode(params, cfg, src, beam_width=3,
+                                          max_len=12))
+    b_plain = np.asarray(nmt.beam_decode(params, cfg, src, beam_width=3,
+                                         max_len=12, use_cache=False))
+    np.testing.assert_array_equal(b_cached, b_plain)
+
+
+@pytest.mark.slow
+def test_file_corpus_train_decode_bleu_golden():
+    """Reference nmt_test.py:48-79 analogue: train on the checked-in
+    file corpus through parallel_run, KV-cached greedy decode, corpus
+    BLEU above the golden bar."""
+    v = nmt_data.Vocab.load(os.path.join(DATA, "vocab.txt"))
+    pairs = nmt_data.load_parallel_corpus(
+        os.path.join(DATA, "train.src"), os.path.join(DATA, "train.tgt"),
+        v, max_len=16)
+    cfg = nmt.tiny_config(vocab_size=len(v), max_len=16,
+                          learning_rate=3e-3, warmup_steps=20,
+                          compute_dtype=jnp.float32)
+    sess, *_ = parallax.parallel_run(
+        nmt.build_model(cfg),
+        parallax_config=parallax.Config(run_option="HYBRID",
+                                        search_partitions=False))
+    it = nmt_data.NMTBatchIterator(pairs, batch_size=16, max_len=16,
+                                   bucket_width=16)
+    loss = None
+    for epoch in range(40):
+        for batch in it.epoch(epoch):
+            loss = sess.run("loss", feed_dict=batch)
+    params = sess.state.params
+    sess.close()
+    assert float(loss) < 1.0, f"copy task failed to train: loss={loss}"
+
+    hyps, refs = [], []
+    eval_pairs = pairs[:32]
+    src = np.full((len(eval_pairs), 16), nmt_data.PAD_ID, np.int32)
+    for i, (s, _) in enumerate(eval_pairs):
+        src[i, :len(s)] = s
+    out = np.asarray(nmt.greedy_decode(params, cfg, src, max_len=12))
+    for row, (s, t) in zip(out, eval_pairs):
+        hyps.append(nmt.ids_to_tokens(row))
+        refs.append([str(i) for i in t])
+    bleu = corpus_bleu(refs, hyps)
+    assert bleu >= 40.0, f"BLEU {bleu:.1f} below golden 40.0"

@@ -1,14 +1,135 @@
-"""Tensor parallelism: Megatron-style sharded kernels via GSPMD."""
+"""Tensor parallelism: Megatron-style sharded kernels (ops/tensor_parallel).
 
+Covers the op-level math, the Megatron communication pattern (collective
+counts in the compiled HLO), engine-level trajectory parity vs pure data
+parallelism for three model families (long_context, BERT, NMT), and the
+TP×SP sequence-parallel composition — VERDICT r3 item 3.
+"""
+
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
+from jax.sharding import Mesh, PartitionSpec as P
 
 import parallax_tpu as parallax
-from parallax_tpu.models import long_context as lc
+from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD
+from parallax_tpu.models import bert, long_context as lc, nmt
+from parallax_tpu.ops import tensor_parallel as tp
 
 
-def _run(parallelism, batches, num_partitions):
-    cfg = lc.tiny_config()
+def _mesh(repl=2, shard=4):
+    devs = np.array(jax.devices()[:repl * shard]).reshape(repl, shard)
+    return Mesh(devs, (AXIS_REPL, AXIS_SHARD))
+
+
+# ---------------------------------------------------------------- op level
+
+
+def test_column_row_parallel_match_plain_matmul(rng):
+    mesh = _mesh()
+    x = jnp.asarray(rng.standard_normal((4, 8, 16)), jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((16, 32)), jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((32, 16)), jnp.float32)
+
+    def fwd(x, w1, w2):
+        h = tp.column_parallel(x, w1, mesh=mesh)
+        return tp.row_parallel(h, w2, mesh=mesh)
+
+    got = jax.jit(fwd)(x, w1, w2)
+    want = (x @ w1) @ w2
+    # sharded contraction changes the fp32 reduction order
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_tp_attention_matches_unsharded(rng):
+    mesh = _mesh()
+    B, T, D, H = 4, 8, 32, 4
+    x = jnp.asarray(rng.standard_normal((B, T, D)), jnp.float32)
+    wqkv = jnp.asarray(rng.standard_normal((D, 3 * D)) * 0.1, jnp.float32)
+    wo = jnp.asarray(rng.standard_normal((D, D)) * 0.1, jnp.float32)
+
+    sharded = jax.jit(lambda x, wqkv, wo: tp.tp_attention(
+        x, x, {"wqkv": wqkv, "wo": wo}, H, causal=True, mesh=mesh))(
+            x, wqkv, wo)
+    plain = jax.jit(lambda x, wqkv, wo: tp.tp_attention(
+        x, x, {"wqkv": wqkv, "wo": wo}, H, causal=True, mesh=None))(
+            x, wqkv, wo)
+    np.testing.assert_allclose(sharded, plain, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------- Megatron collective pattern
+
+
+def _block_fwd(mesh, sequence_parallel):
+    D, M, H = 32, 64, 4
+
+    def fwd(x, wqkv, wo, w1, w2):
+        wqkv = tp.constrain(wqkv, P(None, AXIS_SHARD), mesh)
+        wo = tp.constrain(wo, P(AXIS_SHARD, None), mesh)
+        w1 = tp.constrain(w1, P(None, AXIS_SHARD), mesh)
+        w2 = tp.constrain(w2, P(AXIS_SHARD, None), mesh)
+        y = x + tp.tp_attention(x, x, {"wqkv": wqkv, "wo": wo}, H,
+                                causal=True, mesh=mesh,
+                                sequence_parallel=sequence_parallel)
+        if sequence_parallel:
+            y = tp.seq_shard(y, mesh=mesh)
+        # return the activation, not a scalar: a loss-style global sum
+        # would add its own cross-mesh all-reduce to the counts
+        return y + tp.tp_mlp(y, w1, w2, mesh=mesh,
+                             sequence_parallel=sequence_parallel)
+
+    rng = np.random.default_rng(0)
+    args = (jnp.asarray(rng.standard_normal((4, 8, D)), jnp.float32),
+            jnp.asarray(rng.standard_normal((D, 3 * D)), jnp.float32),
+            jnp.asarray(rng.standard_normal((D, D)), jnp.float32),
+            jnp.asarray(rng.standard_normal((D, M)), jnp.float32),
+            jnp.asarray(rng.standard_normal((M, D)), jnp.float32))
+    return fwd, args
+
+
+def test_megatron_two_allreduce_forward():
+    """The canonical Megatron pattern: exactly two combining collectives
+    per block forward (one after the attention out-proj, one after the
+    MLP down-proj), nothing around the attention core."""
+    fwd, args = _block_fwd(_mesh(), sequence_parallel=False)
+    counts = tp.count_collectives(fwd, *args)
+    assert counts["all_reduce"] == 2, counts
+    assert counts["reduce_scatter"] == 0, counts
+    assert counts["all_to_all"] == 0, counts
+
+
+def test_tp_sp_reshards_sequence_and_regathers():
+    """Sequence-parallel composition: between-block activations rest
+    seq-sharded over the TP axis and the block entries re-gather them.
+
+    (On TPU the closing combine lowers to a true reduce-scatter; XLA:CPU
+    expands it to all-reduce + slice, so the portable assertions are the
+    gathers, the resting sharding, and numeric parity.)"""
+    mesh = _mesh()
+    fwd, args = _block_fwd(mesh, sequence_parallel=True)
+    counts = tp.count_collectives(fwd, *args)
+    assert counts["all_gather"] >= 1, counts
+
+    got = jax.jit(fwd)(*args)
+    # resting sharding: [B, T/tp, D] per device
+    spec = got.sharding.spec
+    assert spec[1] == AXIS_SHARD or spec[1] == (AXIS_SHARD,), spec
+    assert got.sharding.shard_shape(got.shape) == (
+        got.shape[0] // 2, got.shape[1] // 4, got.shape[2])
+
+    # same math as the plain-TP composition
+    fwd0, _ = _block_fwd(mesh, sequence_parallel=False)
+    want = jax.jit(fwd0)(*args)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------- engine: parity
+
+
+def _lc_run(parallelism, batches, num_partitions, **cfg_kw):
+    cfg = lc.tiny_config(**cfg_kw)
     cfg.parallelism = parallelism
     sess, *_ = parallax.parallel_run(
         lc.build_model(cfg),
@@ -24,8 +145,8 @@ def _run(parallelism, batches, num_partitions):
 @pytest.mark.slow
 def test_tp_weights_sharded_and_trajectory_matches_dp(rng):
     batches = [lc.make_batch(rng, 8, 32, 512) for _ in range(4)]
-    tp_losses, tp_state = _run("tensor", batches, 4)   # repl=2, tp=4
-    dp_losses, _ = _run("data", batches, 1)            # pure dp over 8
+    tp_losses, tp_state = _lc_run("tensor", batches, 4)   # repl=2, tp=4
+    dp_losses, _ = _lc_run("data", batches, 1)            # pure dp over 8
 
     # column-parallel qkv: dim1 sharded 4-way; row-parallel wo: dim0
     blk = tp_state.params["blocks"][0]
@@ -34,4 +155,79 @@ def test_tp_weights_sharded_and_trajectory_matches_dp(rng):
     assert blk["wo"].sharding.shard_shape(blk["wo"].shape) == (32 // 4, 32)
     assert blk["w2"].sharding.shard_shape(blk["w2"].shape) == (64 // 4, 32)
     # same math, different layout
+    np.testing.assert_allclose(tp_losses, dp_losses, rtol=2e-3)
+
+
+@pytest.mark.slow
+def test_tp_sp_trajectory_matches_tp(rng):
+    """TP×SP composition trains identically to plain TP (engine level)."""
+    batches = [lc.make_batch(rng, 8, 32, 512) for _ in range(3)]
+    sp_losses, sp_state = _lc_run("tensor", batches, 4,
+                                  tp_sequence_parallel=True)
+    tp_losses, _ = _lc_run("tensor", batches, 4)
+    np.testing.assert_allclose(sp_losses, tp_losses, rtol=2e-3)
+    blk = sp_state.params["blocks"][0]
+    assert blk["w1"].sharding.shard_shape(blk["w1"].shape) == (32, 64 // 4)
+
+
+@pytest.mark.slow
+def test_bert_tp_trajectory_matches_dp():
+    def run(tensor_parallel, num_partitions):
+        cfg = bert.tiny_config(num_heads=4,
+                               compute_dtype=jnp.float32,
+                               tensor_parallel=tensor_parallel)
+        sess, *_ = parallax.parallel_run(
+            bert.build_model(cfg),
+            parallax_config=parallax.Config(run_option="HYBRID",
+                                            search_partitions=False),
+            num_partitions=num_partitions)
+        r = np.random.default_rng(7)
+        batches = [bert.make_batch(r, 8, 32, 4, cfg.vocab_size)
+                   for _ in range(3)]
+        losses = [sess.run("loss", feed_dict=b) for b in batches]
+        state = sess.state
+        sess.close()
+        return losses, state, cfg
+
+    tp_losses, tp_state, cfg = run(True, 4)
+    dp_losses, _, _ = run(False, 1)
+
+    blk = tp_state.params["blocks"][0]
+    D, M = cfg.hidden_dim, cfg.mlp_dim
+    assert blk["wqkv"].sharding.shard_shape(blk["wqkv"].shape) == (
+        D, 3 * D // 4)
+    assert blk["wo"].sharding.shard_shape(blk["wo"].shape) == (D // 4, D)
+    assert blk["w1"].sharding.shard_shape(blk["w1"].shape) == (D, M // 4)
+    assert blk["w2"].sharding.shard_shape(blk["w2"].shape) == (M // 4, D)
+    np.testing.assert_allclose(tp_losses, dp_losses, rtol=2e-3)
+
+
+@pytest.mark.slow
+def test_nmt_tp_trajectory_matches_dp():
+    def run(tensor_parallel, num_partitions):
+        cfg = nmt.tiny_config(compute_dtype=jnp.float32,
+                              tensor_parallel=tensor_parallel)
+        sess, *_ = parallax.parallel_run(
+            nmt.build_model(cfg),
+            parallax_config=parallax.Config(run_option="HYBRID",
+                                            search_partitions=False),
+            num_partitions=num_partitions)
+        r = np.random.default_rng(3)
+        batches = [nmt.make_batch(r, 8, 10, 10, cfg.vocab_size)
+                   for _ in range(3)]
+        losses = [sess.run("loss", feed_dict=b) for b in batches]
+        state = sess.state
+        sess.close()
+        return losses, state, cfg
+
+    tp_losses, tp_state, cfg = run(True, 2)   # repl=4, tp=2 (2 heads)
+    dp_losses, _, _ = run(False, 1)
+
+    D = cfg.model_dim
+    attn = tp_state.params["enc"][0]["attn"]
+    assert attn["wq"].sharding.shard_shape(attn["wq"].shape) == (D, D // 2)
+    assert attn["wo"].sharding.shard_shape(attn["wo"].shape) == (D // 2, D)
+    cross = tp_state.params["dec"][0]["cross"]
+    assert cross["wv"].sharding.shard_shape(cross["wv"].shape) == (
+        D, D // 2)
     np.testing.assert_allclose(tp_losses, dp_losses, rtol=2e-3)

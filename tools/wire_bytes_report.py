@@ -33,27 +33,57 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def flagship_accounting(n_chips: int = 8, batch_per_chip: int = 128,
-                        num_steps: int = 20):
+                        num_steps: int = 20, table_dtype: str = "float32",
+                        dedup_capacity=None):
     """Build the bench's flagship engine (793,470-vocab LM1B, HYBRID,
     slices mode) and return its wire-bytes accounting from an abstract
-    trace of one training step."""
+    trace of one training step.
+
+    ``table_dtype='bfloat16'`` halves every row plane on the wire (the
+    accounting models the element size exactly — ops/embedding.py);
+    ``dedup_capacity`` declares the guarded per-device unique-id slot
+    count (PSConfig.dedup_capacity) — the report then also verifies the
+    declared capacity against the REAL distinct-id counts of the seeded
+    batch so the committed number is never the optimistic lower bound of
+    an overflowing configuration."""
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
-    from parallax_tpu.common.config import ParallaxConfig
+    from parallax_tpu.common.config import (CommunicationConfig,
+                                            ParallaxConfig, PSConfig)
     from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
     from parallax_tpu.models import lm1b
 
     devices = jax.devices()[:n_chips]
     mesh = mesh_lib.build_mesh(devices, num_partitions=n_chips)
     cfg = lm1b.LM1BConfig(num_partitions=n_chips,
-                          sparse_grad_mode="slices")
+                          sparse_grad_mode="slices",
+                          table_dtype=jnp.dtype(table_dtype))
     model = lm1b.build_model(cfg)
     batch = lm1b.make_batch(np.random.default_rng(0),
                             batch_per_chip * n_chips, num_steps,
                             cfg.vocab_size)
-    config = ParallaxConfig(run_option="HYBRID", search_partitions=False,
-                            sparse_grad_mode="slices")
+    overflow_free = None
+    if dedup_capacity is not None:
+        # exactness check on the host: every lookup's per-device
+        # distinct-id count must fit the declared capacity. emb gathers
+        # the input ids; the softmax lookup gathers labels + its
+        # 1/n_chips slice of the log-uniform candidates (distinct count
+        # upper-bounded by labels-distinct + slice length).
+        def max_distinct(arr):
+            return max(len(np.unique(c))
+                       for c in np.split(arr.reshape(-1), n_chips))
+
+        bound = max(max_distinct(batch["x"]),
+                    max_distinct(batch["y"])
+                    + cfg.num_samples // n_chips)
+        overflow_free = bool(bound <= dedup_capacity)
+    config = ParallaxConfig(
+        run_option="HYBRID", search_partitions=False,
+        sparse_grad_mode="slices",
+        communication_config=CommunicationConfig(
+            ps_config=PSConfig(dedup_capacity=dedup_capacity)))
     eng = engine_lib.Engine(model, mesh, config, batch)
 
     # Abstract evaluation: traces the step (filling the per-lookup wire
@@ -64,6 +94,12 @@ def flagship_accounting(n_chips: int = 8, batch_per_chip: int = 128,
     with eng.mesh:
         jax.eval_shape(eng._step_jit, abstract_state, abstract_batch)
     wire = eng.sparse_wire_bytes_per_step()
+    # the reference baseline: TF ships fp32 dense gradients whatever the
+    # table dtype (BASELINE.md). The engine's dense alternative counts
+    # the tables in their OWN dtype; all lm1b tables share table_dtype,
+    # so the fp32 reference is a pure element-size rescale of it.
+    elem = jnp.dtype(cfg.table_dtype).itemsize
+    dense_fp32_ref = wire["dense_allreduce_bytes"] * 4 // elem
     return {
         "config": {
             "model": "lm1b", "vocab_size": cfg.vocab_size,
@@ -71,12 +107,19 @@ def flagship_accounting(n_chips: int = 8, batch_per_chip: int = 128,
             "batch_size": batch_per_chip * n_chips,
             "num_steps": num_steps, "n_chips": n_chips,
             "run_option": "HYBRID", "sparse_grad_mode": "slices",
+            "table_dtype": str(table_dtype),
+            "dedup_capacity": dedup_capacity,
+            "dedup_capacity_overflow_free": overflow_free,
         },
         **wire,
         "sparse_over_dense": (wire["sparse_path_bytes"]
                               / wire["dense_allreduce_bytes"]
                               if wire.get("dense_allreduce_bytes")
                               else None),
+        "dense_fp32_reference_bytes": dense_fp32_ref,
+        "sparse_over_dense_fp32_ref": (wire["sparse_path_bytes"]
+                                       / dense_fp32_ref
+                                       if dense_fp32_ref else None),
     }
 
 
@@ -86,8 +129,13 @@ def main():
                     help="also write the JSON to this path")
     ap.add_argument("--n_chips", type=int, default=8)
     ap.add_argument("--batch_per_chip", type=int, default=128)
+    ap.add_argument("--table_dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--dedup_capacity", type=int, default=None)
     args = ap.parse_args()
-    result = flagship_accounting(args.n_chips, args.batch_per_chip)
+    result = flagship_accounting(args.n_chips, args.batch_per_chip,
+                                 table_dtype=args.table_dtype,
+                                 dedup_capacity=args.dedup_capacity)
     line = json.dumps(result)
     print(line)
     if args.out:
