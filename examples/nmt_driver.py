@@ -85,9 +85,17 @@ def main():
 
     rng = np.random.default_rng(worker_id)
     words, t_last = 0.0, time.perf_counter()
+    # --batch_size is the GLOBAL batch in both modes: the file iterator
+    # row-stripes it across workers, and the synthetic path feeds
+    # batch_size/num_workers rows per worker to match
+    if args.batch_size % max(num_workers, 1):
+        raise ValueError(
+            f"--batch_size {args.batch_size} must divide by the "
+            f"{num_workers} workers")
+    local_bs = args.batch_size // max(num_workers, 1)
     for i in range(args.max_steps):
         batch = (next(batches) if batches is not None
-                 else nmt.make_batch(rng, args.batch_size, args.src_len,
+                 else nmt.make_batch(rng, local_bs, args.src_len,
                                      args.tgt_len, cfg.vocab_size))
         loss, w, step = sess.run(["loss", "words", "global_step"],
                                  feed_dict=batch)

@@ -54,8 +54,11 @@ class Vocab:
 
     @classmethod
     def load(cls, path: str) -> "Vocab":
+        # rstrip CR too: a CRLF vocab file would otherwise carry '\r' in
+        # every token and silently encode the whole corpus to UNK
         with open(path, encoding="utf-8") as f:
-            return cls([line.rstrip("\n") for line in f if line.strip()])
+            return cls([line.rstrip("\r\n") for line in f
+                        if line.strip()])
 
     def encode(self, text: str) -> List[int]:
         return [self.token_to_id.get(t, UNK_ID) for t in text.split()]
@@ -85,11 +88,18 @@ def load_parallel_corpus(src_path: str, tgt_path: str, vocab: Vocab,
     pairs = []
     with open(src_path, encoding="utf-8") as fs, \
             open(tgt_path, encoding="utf-8") as ft:
-        for s_line, t_line in zip(fs, ft):
-            s, t = vocab.encode(s_line), tv.encode(t_line)
-            # tgt gets BOS prepended (input) and EOS appended (output)
-            if s and t and len(s) <= max_len and len(t) + 1 <= max_len:
-                pairs.append((s, t))
+        src_lines, tgt_lines = fs.readlines(), ft.readlines()
+    if len(src_lines) != len(tgt_lines):
+        # silent zip-truncation is THE classic paired-corpus data-loss
+        # bug; a misaligned pair of files must be an error
+        raise ValueError(
+            f"parallel corpus line-count mismatch: {src_path} has "
+            f"{len(src_lines)} lines, {tgt_path} has {len(tgt_lines)}")
+    for s_line, t_line in zip(src_lines, tgt_lines):
+        s, t = vocab.encode(s_line), tv.encode(t_line)
+        # tgt gets BOS prepended (input) and EOS appended (output)
+        if s and t and len(s) <= max_len and len(t) + 1 <= max_len:
+            pairs.append((s, t))
     return pairs
 
 

@@ -66,6 +66,14 @@ def tiny_config(**kw) -> BertConfig:
 def build_model(cfg: BertConfig) -> Model:
     V, D = cfg.padded_vocab, cfg.hidden_dim
     dt = cfg.compute_dtype
+    if cfg.tensor_parallel and cfg.use_pallas_attention:
+        raise ValueError(
+            "tensor_parallel uses the XLA attention core (the Pallas "
+            "kernel does not partition under GSPMD); unset one of "
+            "tensor_parallel / use_pallas_attention")
+    if cfg.tp_sequence_parallel and not cfg.tensor_parallel:
+        raise ValueError(
+            "tp_sequence_parallel requires tensor_parallel=True")
 
     def dense_init(rng, shape):
         return jax.random.normal(rng, shape) * 0.02

@@ -117,6 +117,11 @@ def build_model(cfg: LongContextConfig) -> Model:
     if cfg.tp_sequence_parallel and cfg.parallelism != "tensor":
         raise ValueError(
             "tp_sequence_parallel only applies to parallelism='tensor'")
+    if cfg.parallelism == "tensor" and cfg.use_pallas_attention:
+        raise ValueError(
+            "parallelism='tensor' uses the XLA attention core (the "
+            "Pallas kernel does not partition under GSPMD); unset "
+            "use_pallas_attention")
     Vp = int(cfg.virtual_stages)
     if Vp > 1:
         if cfg.parallelism != "pipeline":
@@ -257,9 +262,14 @@ def build_model(cfg: LongContextConfig) -> Model:
         if cfg.use_ring_attention and mesh is not None:
             placement = ("zigzag" if _zigzag_active(mesh, T)
                          else "contiguous")
+            # block_impl 'auto' = flash kernels on TPU; forcing
+            # use_pallas_attention makes CPU runs exercise them too
             out = ring_attention(q, k, v, mesh, AXIS_SHARD,
                                  causal=True, batch_axis=AXIS_REPL,
-                                 placement=placement)
+                                 placement=placement,
+                                 block_impl=("pallas"
+                                             if cfg.use_pallas_attention
+                                             else "auto"))
         elif cfg.use_pallas_attention:
             from parallax_tpu.ops.pallas_attention import flash_attention
             out = flash_attention(q, k, v, causal=True)
@@ -346,6 +356,14 @@ def build_model(cfg: LongContextConfig) -> Model:
             for p in params["blocks"]:
                 x = block_apply(p, x)
         logits = x.astype(jnp.float32) @ params["out_w"]
+        if tp_mode:
+            # vocab-parallel head (Megatron parallel cross-entropy
+            # shape): out_w is column-sharded so each device holds
+            # logits for V/tp classes; the pin keeps them sharded and
+            # XLA turns the softmax/log-sum-exp reductions into psums —
+            # the full [B*T, V] logits never materialize on one device
+            logits = tp_ops.constrain(
+                logits, P(AXIS_REPL, None, AXIS_SHARD))
         if zig:
             labels = ids[:, label_map]
             w = jnp.broadcast_to(jnp.asarray(w_np)[None],
@@ -446,6 +464,8 @@ def build_model(cfg: LongContextConfig) -> Model:
             param_specs={
                 **tp_ops.attention_param_specs("blocks/*"),
                 **tp_ops.mlp_param_specs("blocks/*"),
+                # vocab-parallel output head
+                "out_w": P(None, AXIS_SHARD),
             })
     if cfg.parallelism == "ring":
         # dp over 'repl', sp over 'shard': [batch, seq] inputs

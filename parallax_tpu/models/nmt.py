@@ -90,7 +90,10 @@ def _attention(q, k, v, mask, num_heads):
         return x.reshape(B, T, h, hd).transpose(0, 2, 1, 3)
 
     qh, kh, vh = split(q, Tq), split(k, Tk), split(v, Tk)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(hd)
+    # fp32 accumulation (free on the MXU) — also keeps the TP path
+    # (ops/tensor_parallel.tp_attention) the same math as this one
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) / np.sqrt(hd)
     scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     probs = probs.astype(q.dtype)
@@ -287,6 +290,11 @@ def _decode_step_cached(cfg, params, tok, t, kc, vc, ck, cv, src_valid):
 
 def build_model(cfg: NMTConfig) -> Model:
     V, D = cfg.padded_vocab, cfg.model_dim
+    if cfg.tensor_parallel and cfg.use_pallas_attention:
+        raise ValueError(
+            "tensor_parallel uses the XLA attention core (the Pallas "
+            "kernel does not partition under GSPMD); unset one of "
+            "tensor_parallel / use_pallas_attention")
 
     def dense_init(rng, shape):
         return jax.random.normal(rng, shape) * (1.0 / np.sqrt(shape[0]))
@@ -452,6 +460,31 @@ def beam_decode(params, cfg: NMTConfig, src, beam_width: int = 4,
     done = jnp.zeros((B, K), bool)
     lengths = jnp.zeros((B, K), jnp.float32)
 
+    def beam_step(t, logits, tgt, logp, done, lengths):
+        """Shared per-step beam bookkeeping: finished-beam PAD scoring,
+        joint top-k over (parent beam, token), parent-state reorder,
+        token write, length/done update. Returns the new carry plus the
+        winning parent indices (the cached path reorders its K/V caches
+        by them)."""
+        step_logp = jax.nn.log_softmax(logits).reshape(B, K, V)
+        # finished beams may only emit PAD, at no cost
+        pad_only = jnp.full((V,), NEG).at[PAD_ID].set(0.0)
+        step_logp = jnp.where(done[:, :, None], pad_only[None, None],
+                              step_logp)
+        cand = logp[:, :, None] + step_logp              # [B, K, V]
+        flat = cand.reshape(B, K * V)
+        top_logp, top_idx = jax.lax.top_k(flat, K)       # [B, K]
+        beam_idx = top_idx // V
+        tok = (top_idx % V).astype(jnp.int32)
+        # reorder carried state by the winning parent beams
+        tgt = jnp.take_along_axis(tgt, beam_idx[:, :, None], axis=1)
+        done = jnp.take_along_axis(done, beam_idx, axis=1)
+        lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+        tgt = jax.lax.dynamic_update_index_in_dim(tgt, tok, t + 1, 2)
+        lengths = jnp.where(done, lengths, lengths + 1.0)
+        done = done | (tok == EOS_ID)
+        return tgt, top_logp, done, lengths, beam_idx
+
     if use_cache:
         ck, cv = _cross_kv(cfg, params, enc_k)
         kc0, vc0 = _init_self_cache(cfg, B * K, T)
@@ -469,24 +502,11 @@ def beam_decode(params, cfg: NMTConfig, src, beam_width: int = 4,
                 tgt.reshape(B * K, T + 1), t, axis=1, keepdims=False)
             logits, kc, vc = _decode_step_cached(
                 cfg, params, tok_in, t, kc, vc, ck, cv, valid_k)
-            step_logp = jax.nn.log_softmax(logits).reshape(B, K, V)
-            pad_only = jnp.full((V,), NEG).at[PAD_ID].set(0.0)
-            step_logp = jnp.where(done[:, :, None], pad_only[None, None],
-                                  step_logp)
-            cand = logp[:, :, None] + step_logp
-            flat = cand.reshape(B, K * V)
-            top_logp, top_idx = jax.lax.top_k(flat, K)
-            beam_idx = top_idx // V
-            tok = (top_idx % V).astype(jnp.int32)
-            tgt = jnp.take_along_axis(tgt, beam_idx[:, :, None], axis=1)
-            done = jnp.take_along_axis(done, beam_idx, axis=1)
-            lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+            tgt, logp, done, lengths, beam_idx = beam_step(
+                t, logits, tgt, logp, done, lengths)
             kc = reorder_cache(kc, beam_idx)
             vc = reorder_cache(vc, beam_idx)
-            tgt = jax.lax.dynamic_update_index_in_dim(tgt, tok, t + 1, 2)
-            lengths = jnp.where(done, lengths, lengths + 1.0)
-            done = done | (tok == EOS_ID)
-            return tgt, top_logp, done, lengths, kc, vc
+            return tgt, logp, done, lengths, kc, vc
 
         tgt, logp, done, lengths, *_ = jax.lax.fori_loop(
             0, T, body, (tgt, logp, done, lengths, kc0, vc0))
@@ -496,24 +516,9 @@ def beam_decode(params, cfg: NMTConfig, src, beam_width: int = 4,
             logits = _decode_step_logits(
                 cfg, params, tgt.reshape(B * K, T + 1)[:, :-1],
                 enc_k, valid_k, t)
-            step_logp = jax.nn.log_softmax(logits).reshape(B, K, V)
-            # finished beams may only emit PAD, at no cost
-            pad_only = jnp.full((V,), NEG).at[PAD_ID].set(0.0)
-            step_logp = jnp.where(done[:, :, None], pad_only[None, None],
-                                  step_logp)
-            cand = logp[:, :, None] + step_logp          # [B, K, V]
-            flat = cand.reshape(B, K * V)
-            top_logp, top_idx = jax.lax.top_k(flat, K)   # [B, K]
-            beam_idx = top_idx // V
-            tok = (top_idx % V).astype(jnp.int32)
-            # reorder carried state by the winning parent beams
-            tgt = jnp.take_along_axis(tgt, beam_idx[:, :, None], axis=1)
-            done = jnp.take_along_axis(done, beam_idx, axis=1)
-            lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
-            tgt = jax.lax.dynamic_update_index_in_dim(tgt, tok, t + 1, 2)
-            lengths = jnp.where(done, lengths, lengths + 1.0)
-            done = done | (tok == EOS_ID)
-            return tgt, top_logp, done, lengths
+            tgt, logp, done, lengths, _ = beam_step(
+                t, logits, tgt, logp, done, lengths)
+            return tgt, logp, done, lengths
 
         tgt, logp, done, lengths = jax.lax.fori_loop(
             0, T, body, (tgt, logp, done, lengths))

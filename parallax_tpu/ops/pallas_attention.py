@@ -84,6 +84,16 @@ def _flash_fwd_kernel(*refs, kv_len: int, block_k: int, causal: bool,
     lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the caller's varying-mesh-axes set —
+    required when the kernels run inside a shard_map (the ring
+    attention block path); a plain struct elsewhere."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _snap(tile, total):
     tile = min(tile, total)
     while total % tile:
@@ -123,8 +133,8 @@ def _flash_forward(q, k, v, kv_mask, causal: bool, scale: float,
             pl.BlockSpec((1, 1, q_tile), lambda b, h, i: (b, h, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Tq), jnp.float32),
+            _sds((B, H, Tq, D), q.dtype, q),
+            _sds((B, H, Tq), jnp.float32, q),
         ],
         interpret=interpret,
     )(*operands)
@@ -236,13 +246,17 @@ def _flash_dkv_kernel(*refs, q_len: int, q_blk: int, causal: bool,
 
 
 def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
-                    q_tile, block_k, interpret):
+                    q_tile, block_k, interpret, dlse=None):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     q_tile = _snap(q_tile, Tq)
     block_k = _snap(block_k, Tk)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                               # [B, H, Tq]
+    if dlse is not None:
+        # lse cotangent folds into the existing kernels exactly:
+        # d s = p*(dp - delta) + dlse*p = p*(dp - (delta - dlse))
+        delta = delta - dlse.astype(jnp.float32)
 
     has_mask = kv_mask is not None
     dq_specs = [
@@ -268,7 +282,7 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, q_tile, D),
                                lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+        out_shape=_sds((B, H, Tq, D), q.dtype, q),
         interpret=interpret,
     )(*dq_operands)
 
@@ -301,8 +315,8 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
                          lambda b, h, j: (b, h, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Tk, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, Tk, D), v.dtype),
+            _sds((B, H, Tk, D), k.dtype, k),
+            _sds((B, H, Tk, D), v.dtype, v),
         ],
         interpret=interpret,
     )(*dkv_operands)
@@ -359,6 +373,90 @@ def _bwd_masked(causal, scale, q_tile, block_k, interpret, xla_backward,
 
 
 _flash_attention_masked.defvjp(_fwd_masked, _bwd_masked)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_attention_with_lse(q, k, v, kv_mask, causal, scale, q_tile,
+                              block_k, interpret, xla_backward):
+    """(out, lse) variant — the composition surface for ring attention:
+    per-block partial softmaxes merge exactly from (out, lse) pairs, and
+    the lse cotangent is a delta-shift in the unchanged backward kernels."""
+    return _flash_forward(q, k, v, kv_mask, causal, scale, q_tile,
+                          block_k, interpret)
+
+
+def _fwd_lse(q, k, v, kv_mask, causal, scale, q_tile, block_k,
+             interpret, xla_backward):
+    out, lse = _flash_forward(q, k, v, kv_mask, causal, scale, q_tile,
+                              block_k, interpret)
+    return (out, lse), (q, k, v, kv_mask, out, lse)
+
+
+def _xla_attention_lse(q, k, v, kv_mask, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k,
+                   preferred_element_type=jnp.float32)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :] > 0, s, _NEG_INF)
+    if causal:
+        T, Tk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((T, Tk), bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    lse = jax.nn.logsumexp(s, axis=-1)
+    # clamp so fully-masked rows (lse == -inf) yield 0, not exp(nan)
+    p = jnp.exp(s - jnp.maximum(lse, _NEG_INF)[..., None])
+    p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p,
+                     v.astype(jnp.float32)).astype(q.dtype)
+    return out, lse
+
+
+def _bwd_lse(causal, scale, q_tile, block_k, interpret, xla_backward,
+             res, g):
+    q, k, v, kv_mask, out, lse = res
+    dout, dlse = g
+    if xla_backward:
+        _, vjp = jax.vjp(
+            lambda q, k, v: _xla_attention_lse(q, k, v, kv_mask, causal,
+                                               scale), q, k, v)
+        dq, dk, dv = vjp((dout, dlse))
+    else:
+        dq, dk, dv = _flash_backward(q, k, v, kv_mask, out, lse, dout,
+                                     causal, scale, q_tile, block_k,
+                                     interpret, dlse=dlse)
+    mask_ct = (None if kv_mask is None else
+               np.zeros(kv_mask.shape, dtype=jax.dtypes.float0))
+    return dq, dk, dv, mask_ct
+
+
+_flash_attention_with_lse.defvjp(_fwd_lse, _bwd_lse)
+
+
+def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = False,
+                        scale: Optional[float] = None,
+                        kv_mask: Optional[jax.Array] = None,
+                        q_tile: int = 256, block_k: int = 256,
+                        interpret: Optional[bool] = None,
+                        xla_backward: bool = False):
+    """Fused attention returning (out [B, T, H, D], lse [B, H, T]).
+
+    Same kernels as `flash_attention` plus the log-sum-exp output, so a
+    caller (ops/ring_attention.py block_impl='pallas') can merge partial
+    attentions over key blocks exactly: out = Σ_b out_b·exp(lse_b-lse),
+    lse = logaddexp_b(lse_b). Differentiable in all inputs including
+    through lse.
+    """
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if kv_mask is not None:
+        kv_mask = kv_mask.astype(jnp.int32)
+    out, lse = _flash_attention_with_lse(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), kv_mask, causal, float(scale), q_tile,
+        block_k, interpret, xla_backward)
+    return out.transpose(0, 2, 1, 3), lse
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,

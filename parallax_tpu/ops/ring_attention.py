@@ -67,17 +67,35 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    causal: bool = False,
                    scale: Optional[float] = None,
                    batch_axis: Optional[str] = None,
-                   placement: str = "contiguous") -> jax.Array:
+                   placement: str = "contiguous",
+                   block_impl: str = "auto") -> jax.Array:
     """Attention with the sequence dimension sharded over ``axis``.
 
     q, k, v: [B, T, H, D] with T sharded over ``axis`` (global views);
     ``batch_axis`` optionally shards B over another mesh axis (dp x sp).
     Returns [B, T, H, D] sharded the same way.
+
+    ``block_impl`` selects the per-block attention core: 'xla' (einsum
+    online-softmax), 'pallas' (the flash kernels of
+    ops/pallas_attention — each block tile runs fused in VMEM and the
+    partials merge exactly from the kernels' (out, lse); ~flash-level
+    HBM traffic inside the ring), or 'auto' (pallas on TPU backends,
+    xla elsewhere).
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     if placement not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown placement {placement!r}")
+    if block_impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown block_impl {block_impl!r}")
+    use_flash = (block_impl == "pallas"
+                 or (block_impl == "auto"
+                     and jax.default_backend() == "tpu"))
+    # Pallas INTERPRET mode (CPU tests) trips the shard_map VMA checker
+    # (jax suggests check_vma=False as the workaround); compiled TPU
+    # kernels carry their vma (ops/pallas_attention._sds) and keep the
+    # checker on.
+    flash_interpret = use_flash and jax.default_backend() != "tpu"
     zigzag = placement == "zigzag"
     n = mesh.shape[axis]
     if zigzag and q.shape[1] % (2 * n):
@@ -104,15 +122,33 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
         # mark the accumulators as device-varying over every mesh axis the
         # blocks vary over, so the scan carry type matches its output
-        # (they pick up per-device values).
+        # (they pick up per-device values). No pcast when the checker is
+        # off (flash interpret mode) — it must not be emitted there.
         vary = (axis,) if batch_axis is None else (axis, batch_axis)
 
         def pvary(x):
+            if flash_interpret:
+                return x
             return jax.lax.pcast(x, vary, to="varying")
 
         m0 = pvary(jnp.full((B, H, Tq), _NEG_INF, jnp.float32))
         l0 = pvary(jnp.zeros((B, H, Tq), jnp.float32))
         o0 = pvary(jnp.zeros((B, H, Tq, D), jnp.float32))
+
+        def online_update(scores, vh, m, l, o):
+            """Flash-style online softmax update of (m, l, o) with a new
+            score tile (callers pre-mask or pass maskless tiles)."""
+            m_new = jnp.maximum(m, scores.max(axis=-1))
+            alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+            p = jnp.exp(scores - m_new[..., None])
+            # fully-masked rows have scores == m_new == _NEG_INF, where
+            # exp(0) would leak mass — zero them explicitly
+            p = jnp.where(scores > _NEG_INF / 2, p, 0.0)
+            l = l * alpha + p.sum(axis=-1)
+            o = (o * alpha[..., None]
+                 + jnp.einsum("bhqk,bhkd->bhqd", p,
+                              vh.astype(jnp.float32)))
+            return m_new, l, o
 
         def accumulate(k_blk, v_blk, s, m, l, o):
             # Block s originated on device (idx - s) mod n.
@@ -127,31 +163,130 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 k_pos = positions(kv_origin)
                 mask = q_pos[:, None] >= k_pos[None, :]
                 scores = jnp.where(mask[None, None], scores, _NEG_INF)
-            m_new = jnp.maximum(m, scores.max(axis=-1))
-            alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
-            p = jnp.exp(scores - m_new[..., None])
-            # fully-masked rows have scores == m_new == _NEG_INF, where
-            # exp(0) would leak mass — zero them explicitly
-            p = jnp.where(scores > _NEG_INF / 2, p, 0.0)
-            l = l * alpha + p.sum(axis=-1)
-            o = (o * alpha[..., None]
-                 + jnp.einsum("bhqk,bhkd->bhqd", p,
-                              vh.astype(jnp.float32)))
-            return m_new, l, o
+            return online_update(scores, vh, m, l, o)
 
-        def online_update(scores, vh, m, l, o):
-            """Flash-style online softmax update of (m, l, o) with a new
-            score tile (no masking — callers pre-mask or pass maskless
-            tiles)."""
-            m_new = jnp.maximum(m, scores.max(axis=-1))
-            alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
-            p = jnp.exp(scores - m_new[..., None])
-            p = jnp.where(scores > _NEG_INF / 2, p, 0.0)
-            l = l * alpha + p.sum(axis=-1)
-            o = (o * alpha[..., None]
-                 + jnp.einsum("bhqk,bhkd->bhqd", p,
-                              vh.astype(jnp.float32)))
-            return m_new, l, o
+        def normalize(l, o):
+            denom = jnp.maximum(l, 1e-30)[..., None]
+            out = (o / denom).transpose(0, 2, 1, 3)       # [B, Tq, H, D]
+            return out.astype(q_loc.dtype)
+
+        rot_perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def rotate(k_blk, v_blk):
+            return (jax.lax.ppermute(k_blk, axis, rot_perm),
+                    jax.lax.ppermute(v_blk, axis, rot_perm))
+
+        if use_flash:
+            # Per-block attention runs the fused flash kernels
+            # (ops/pallas_attention); partials fold into the online
+            # (m, l, o) accumulators exactly via each tile's lse.
+            from parallax_tpu.ops.pallas_attention import (
+                flash_attention_lse)
+
+            def flash_merge(q_sub, k_sub, v_sub, flash_causal, m, l, o):
+                """One flash tile (q_sub [B, Tq', H, D] x k/v_sub
+                [B, Tk', H, D]) merged into row-aligned (m, l, o)."""
+                out_b, lse_b = flash_attention_lse(
+                    q_sub, k_sub, v_sub, causal=flash_causal,
+                    scale=scale)
+                ob = out_b.transpose(0, 2, 1, 3).astype(jnp.float32)
+                m_new = jnp.maximum(m, lse_b)
+                alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+                w = jnp.exp(lse_b - m_new)
+                l = l * alpha + w
+                o = o * alpha[..., None] + ob * w[..., None]
+                return m_new, l, o
+
+            if causal and zigzag and n > 1:
+                # self tile: three maskful/maskless quadrants (lo-lo
+                # causal, hi-lo full, hi-hi causal; lo-hi is masked)
+                h = Tq // 2
+                q_lo, q_hi = q_loc[:, :h], q_loc[:, h:]
+                m_lo, l_lo, o_lo = flash_merge(
+                    q_lo, k_loc[:, :h], v_loc[:, :h], True,
+                    m0[:, :, :h], l0[:, :, :h], o0[:, :, :h])
+                m_hi, l_hi, o_hi = flash_merge(
+                    q_hi, k_loc[:, :h], v_loc[:, :h], False,
+                    m0[:, :, h:], l0[:, :, h:], o0[:, :, h:])
+                m_hi, l_hi, o_hi = flash_merge(
+                    q_hi, k_loc[:, h:], v_loc[:, h:], True,
+                    m_hi, l_hi, o_hi)
+                m = jnp.concatenate([m_lo, m_hi], 2)
+                l = jnp.concatenate([l_lo, l_hi], 2)
+                o = jnp.concatenate([o_lo, o_hi], 2)
+
+                def fstep(carry, s):
+                    k_blk, v_blk, m, l, o = carry
+                    k_blk, v_blk = rotate(k_blk, v_blk)
+                    kv_origin = (idx - s) % n
+
+                    def earlier(args):
+                        k_blk, v_blk, m, l, o = args
+                        return flash_merge(q_loc, k_blk[:, :h],
+                                           v_blk[:, :h], False, m, l, o)
+
+                    def later(args):
+                        k_blk, v_blk, m, l, o = args
+                        m_hi, l_hi, o_hi = flash_merge(
+                            q_loc[:, h:], k_blk, v_blk, False,
+                            m[:, :, h:], l[:, :, h:], o[:, :, h:])
+                        return (jnp.concatenate([m[:, :, :h], m_hi], 2),
+                                jnp.concatenate([l[:, :, :h], l_hi], 2),
+                                jnp.concatenate([o[:, :, :h], o_hi], 2))
+
+                    m, l, o = jax.lax.cond(kv_origin < idx, earlier,
+                                           later,
+                                           (k_blk, v_blk, m, l, o))
+                    return (k_blk, v_blk, m, l, o), None
+
+                (_, _, m, l, o), _ = jax.lax.scan(
+                    fstep, (k_loc, v_loc, m, l, o), jnp.arange(1, n))
+                return normalize(l, o)
+
+            def fstep(carry, s):
+                k_blk, v_blk, m, l, o = carry
+                kv_origin = (idx - s) % n
+
+                def self_tile(args):
+                    return flash_merge(q_loc, args[0], args[1], True,
+                                       *args[2:])
+
+                def full_tile(args):
+                    return flash_merge(q_loc, args[0], args[1], False,
+                                       *args[2:])
+
+                if causal:
+                    # contiguous: self block in-block causal, earlier
+                    # blocks full, later blocks fully masked -> skip
+                    m, l, o = jax.lax.cond(
+                        kv_origin <= idx,
+                        lambda a: jax.lax.cond(kv_origin == idx,
+                                               self_tile, full_tile, a),
+                        lambda a: (a[2], a[3], a[4]),
+                        (k_blk, v_blk, m, l, o))
+                else:
+                    m, l, o = full_tile((k_blk, v_blk, m, l, o))
+                k_blk, v_blk = rotate(k_blk, v_blk)
+                return (k_blk, v_blk, m, l, o), None
+
+            (k_l, v_l, m, l, o), _ = jax.lax.scan(
+                fstep, (k_loc, v_loc, m0, l0, o0), jnp.arange(n - 1))
+            s_last = n - 1
+            kv_origin = (idx - s_last) % n
+            if causal:
+                m, l, o = jax.lax.cond(
+                    kv_origin <= idx,
+                    lambda a: jax.lax.cond(
+                        kv_origin == idx,
+                        lambda a: flash_merge(q_loc, a[0], a[1], True,
+                                              *a[2:]),
+                        lambda a: flash_merge(q_loc, a[0], a[1], False,
+                                              *a[2:]), a),
+                    lambda a: (a[2], a[3], a[4]),
+                    (k_l, v_l, m, l, o))
+            else:
+                m, l, o = flash_merge(q_loc, k_l, v_l, False, m, l, o)
+            return normalize(l, o)
 
         if causal and zigzag and n > 1:
             # Balanced zigzag fast path. Device idx holds real blocks
@@ -193,9 +328,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
             def step(carry, s):
                 k_blk, v_blk, m, l, o = carry
-                perm = [(i, (i + 1) % n) for i in range(n)]
-                k_blk = jax.lax.ppermute(k_blk, axis, perm)
-                v_blk = jax.lax.ppermute(v_blk, axis, perm)
+                k_blk, v_blk = rotate(k_blk, v_blk)
                 kv_origin = (idx - s) % n
                 m, l, o = jax.lax.cond(
                     kv_origin < idx, half_earlier, half_later,
@@ -204,9 +337,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
             (_, _, m, l, o), _ = jax.lax.scan(
                 step, (k_loc, v_loc, m, l, o), jnp.arange(1, n))
-            denom = jnp.maximum(l, 1e-30)[..., None]
-            out = (o / denom).transpose(0, 2, 1, 3)
-            return out.astype(q_loc.dtype)
+            return normalize(l, o)
 
         def step(carry, s):
             k_blk, v_blk, m, l, o = carry
@@ -223,9 +354,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             else:
                 m, l, o = accumulate(k_blk, v_blk, s, m, l, o)
             # rotate the K/V block around the ring
-            perm = [(i, (i + 1) % n) for i in range(n)]
-            k_blk = jax.lax.ppermute(k_blk, axis, perm)
-            v_blk = jax.lax.ppermute(v_blk, axis, perm)
+            k_blk, v_blk = rotate(k_blk, v_blk)
             return (k_blk, v_blk, m, l, o), None
 
         # n-1 steps rotate; the last block is consumed without the (dead)
@@ -233,13 +362,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         (k_l, v_l, m, l, o), _ = jax.lax.scan(
             step, (k_loc, v_loc, m0, l0, o0), jnp.arange(n - 1))
         m, l, o = accumulate(k_l, v_l, n - 1, m, l, o)
-        denom = jnp.maximum(l, 1e-30)[..., None]
-        out = (o / denom).transpose(0, 2, 1, 3)           # [B, Tq, H, D]
-        return out.astype(q_loc.dtype)
+        return normalize(l, o)
 
     return jax.shard_map(local, mesh=mesh,
                          in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+                         out_specs=spec,
+                         check_vma=not flash_interpret)(q, k, v)
 
 
 def full_attention_reference(q, k, v, causal=False, scale=None):

@@ -139,8 +139,12 @@ class SliceAdagrad:
     grad_scale: float = 1.0
 
     def init(self, param: jax.Array) -> jax.Array:
+        # fp32 accumulator even for bf16 tables: the sum-of-squares adds
+        # tiny g² increments that underflow bf16's 8 mantissa bits (the
+        # accumulator would freeze and adagrad degrade to fixed-rate
+        # SGD); it never crosses the wire, so fp32 costs only HBM
         return jnp.full(param.shape, self.initial_accumulator_value,
-                        param.dtype)
+                        jnp.float32)
 
     def update(self, param: jax.Array, acc: jax.Array, ids: jax.Array,
                drows: jax.Array, average: bool = False):
@@ -153,7 +157,7 @@ class SliceAdagrad:
         lookup's sentinel handling).
         """
         V = param.shape[0]
-        uids, gsum = _combine_slices(ids, drows, V, param.dtype, average,
+        uids, gsum = _combine_slices(ids, drows, V, jnp.float32, average,
                                      self.grad_scale)
         # NOTE: deliberately NO unique_indices/indices_are_sorted hints:
         # measured on v5e, the hinted scatter lowers ~3x SLOWER than the
@@ -249,14 +253,15 @@ class SliceAdam:
     grad_scale: float = 1.0
 
     def init(self, param: jax.Array) -> SliceAdamState:
-        return SliceAdamState(jnp.zeros_like(param),
-                              jnp.zeros_like(param),
-                              jnp.zeros((), jnp.int32))
+        # fp32 moments for the same underflow reason as SliceAdagrad's
+        # accumulator (v accumulates (1-b2)·g², far below bf16 epsilon)
+        z = jnp.zeros(param.shape, jnp.float32)
+        return SliceAdamState(z, z, jnp.zeros((), jnp.int32))
 
     def update(self, param: jax.Array, state: SliceAdamState,
                ids: jax.Array, drows: jax.Array, average: bool = False):
         V = param.shape[0]
-        uids, gsum = _combine_slices(ids, drows, V, param.dtype, average,
+        uids, gsum = _combine_slices(ids, drows, V, jnp.float32, average,
                                      self.grad_scale)
         t = state.count + 1
         m_r = (self.b1 * state.m.at[uids, :].get(mode="fill",
@@ -265,9 +270,9 @@ class SliceAdam:
         v_r = (self.b2 * state.v.at[uids, :].get(mode="fill",
                                                  fill_value=0.0)
                + (1.0 - self.b2) * gsum * gsum)
-        tf_ = t.astype(param.dtype)
-        m_hat = m_r / (1.0 - jnp.asarray(self.b1, param.dtype) ** tf_)
-        v_hat = v_r / (1.0 - jnp.asarray(self.b2, param.dtype) ** tf_)
+        tf_ = t.astype(jnp.float32)
+        m_hat = m_r / (1.0 - jnp.asarray(self.b1, jnp.float32) ** tf_)
+        v_hat = v_r / (1.0 - jnp.asarray(self.b2, jnp.float32) ** tf_)
         u_rows = (-self.learning_rate * m_hat
                   / (jnp.sqrt(v_hat) + self.eps))
         # sentinel rows (id == V) have zero gsum; with zero moments their

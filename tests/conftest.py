@@ -17,6 +17,22 @@ import jax  # noqa: E402
 # (e.g. to a real TPU backend) before this conftest runs, so override at
 # runtime rather than via env.
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the suite is compile-dominated (every
+# engine test pjits a training step), so repeat local runs get most of
+# their wall time back. Keyed by HLO + compile env, so a stale cache can
+# only miss, never corrupt. Disable with PARALLAX_JIT_CACHE=0.
+if os.environ.get("PARALLAX_JIT_CACHE", "1") != "0":
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("PARALLAX_JIT_CACHE_DIR",
+                           os.path.join(os.path.dirname(__file__), "..",
+                                        ".jax_cache")))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    except Exception:  # older jax without the knobs: run uncached
+        pass
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

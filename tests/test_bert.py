@@ -7,6 +7,7 @@ import parallax_tpu as parallax
 from parallax_tpu.models import bert
 
 
+@pytest.mark.slow
 def test_classification_and_training(rng):
     cfg = bert.tiny_config(num_partitions=8, learning_rate=1e-3)
     model = bert.build_model(cfg)

@@ -73,6 +73,7 @@ def test_lenet_trains_and_updates_batch_stats(rng):
     sess.close()
 
 
+@pytest.mark.slow
 def test_stateful_model_batch_stats_flow(rng):
     """A BatchNorm model (tiny resnet-ish via densenet? use resnet50 at
     32px) must carry batch_stats through TrainState and update them."""

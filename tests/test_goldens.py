@@ -65,6 +65,7 @@ def test_long_context_first_loss_golden(rng):
     assert abs(loss - 7.431) < 0.3, loss
 
 
+@pytest.mark.slow
 def test_resnet50_first_loss_golden(rng):
     from parallax_tpu.models import cnn
     model = cnn.build_model("resnet50_v1.5", num_classes=100,

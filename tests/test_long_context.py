@@ -87,6 +87,7 @@ def test_zigzag_ring_matches_contiguous_trajectory(rng):
     np.testing.assert_allclose(run(True), run(False), rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_remat_matches_non_remat_trajectory(rng):
     """jax.checkpoint rematerialization changes memory, not math: the
     trajectories track (recompute reorders bf16 rounding, so agreement

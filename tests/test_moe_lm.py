@@ -1,11 +1,13 @@
 """MoE LM through the engine: expert weights sharded, training works."""
 
 import numpy as np
+import pytest
 
 import parallax_tpu as parallax
 from parallax_tpu.models import moe_lm
 
 
+@pytest.mark.slow
 def test_expert_parallel_training(rng):
     cfg = moe_lm.tiny_config(num_partitions=4, learning_rate=1e-3)
     model = moe_lm.build_model(cfg)

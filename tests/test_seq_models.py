@@ -23,6 +23,7 @@ class TestNMT:
         assert not sess.state.params["emb"].sharding.is_fully_replicated
         sess.close()
 
+    @pytest.mark.slow
     def test_training_reduces_loss(self, rng):
         cfg = nmt.tiny_config(num_partitions=8, learning_rate=3e-3,
                               warmup_steps=10)

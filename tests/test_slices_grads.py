@@ -64,6 +64,7 @@ def test_matches_dense_mode_with_averaging():
     np.testing.assert_allclose(dense, slices, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_clip_covers_only_dense_group():
     """With a tight clip the two modes MUST differ: dense mode clips
     table grads too; slices mode (reference semantics,

@@ -88,6 +88,7 @@ def test_rejects_non_table_params():
         tx.update(jnp.zeros((8,)), s, p)
 
 
+@pytest.mark.slow
 def test_lm1b_wiring_trajectory_unchanged(rng):
     """LM1BConfig.max_touched_rows routes tables to the scatter path with
     an unchanged training trajectory."""

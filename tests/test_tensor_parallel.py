@@ -154,6 +154,9 @@ def test_tp_weights_sharded_and_trajectory_matches_dp(rng):
         32, (3 * 32) // 4)
     assert blk["wo"].sharding.shard_shape(blk["wo"].shape) == (32 // 4, 32)
     assert blk["w2"].sharding.shard_shape(blk["w2"].shape) == (64 // 4, 32)
+    # vocab-parallel head: each device holds V/tp output classes
+    ow = tp_state.params["out_w"]
+    assert ow.sharding.shard_shape(ow.shape) == (32, 512 // 4)
     # same math, different layout
     np.testing.assert_allclose(tp_losses, dp_losses, rtol=2e-3)
 
