@@ -45,8 +45,10 @@ class CheckpointHook:
             # force=True on save.
             opts = ocp.CheckpointManagerOptions(
                 save_interval_steps=1,
-                max_to_keep=None)  # reference keeps everything
+                max_to_keep=None,  # reference keeps everything
                                    # (max_to_keep=1000000, lib.py:44)
+                enable_async_checkpointing=bool(
+                    getattr(self._config, "async_save", True)))
             self._mngr = ocp.CheckpointManager(
                 os.path.abspath(self._config.ckpt_dir), options=opts)
 

@@ -105,6 +105,12 @@ class CheckPointConfig:
     ckpt_dir: Optional[str] = None
     save_ckpt_steps: Optional[int] = None
     save_ckpt_secs: Optional[float] = None
+    # Asynchronous saves (TPU-extra knob): the save dispatches device->
+    # host transfers and returns, with serialization/commit on a
+    # background thread while training continues — the step never blocks
+    # on storage. Session close / the next save waits for the previous
+    # commit. False = fully synchronous saves (reference behavior).
+    async_save: bool = True
 
 
 @dataclasses.dataclass

@@ -84,22 +84,26 @@ def load_parallel_corpus(src_path: str, tgt_path: str, vocab: Vocab,
     pairs and pairs longer than ``max_len`` after the BOS/EOS the model
     adds (the reference's tf.logical_and length filter,
     iterator_utils.py)."""
+    import itertools
+
     tv = tgt_vocab or vocab
     pairs = []
     with open(src_path, encoding="utf-8") as fs, \
             open(tgt_path, encoding="utf-8") as ft:
-        src_lines, tgt_lines = fs.readlines(), ft.readlines()
-    if len(src_lines) != len(tgt_lines):
-        # silent zip-truncation is THE classic paired-corpus data-loss
-        # bug; a misaligned pair of files must be an error
-        raise ValueError(
-            f"parallel corpus line-count mismatch: {src_path} has "
-            f"{len(src_lines)} lines, {tgt_path} has {len(tgt_lines)}")
-    for s_line, t_line in zip(src_lines, tgt_lines):
-        s, t = vocab.encode(s_line), tv.encode(t_line)
-        # tgt gets BOS prepended (input) and EOS appended (output)
-        if s and t and len(s) <= max_len and len(t) + 1 <= max_len:
-            pairs.append((s, t))
+        for i, (s_line, t_line) in enumerate(
+                itertools.zip_longest(fs, ft)):
+            if s_line is None or t_line is None:
+                # silent zip-truncation is THE classic paired-corpus
+                # data-loss bug; misaligned files must be an error
+                # (streaming check: O(1) memory on huge corpora)
+                short = src_path if s_line is None else tgt_path
+                raise ValueError(
+                    f"parallel corpus line-count mismatch: {short} "
+                    f"ends at line {i} before its pair file")
+            s, t = vocab.encode(s_line), tv.encode(t_line)
+            # tgt gets BOS prepended (input) and EOS appended (output)
+            if s and t and len(s) <= max_len and len(t) + 1 <= max_len:
+                pairs.append((s, t))
     return pairs
 
 

@@ -243,8 +243,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     fstep, (k_loc, v_loc, m, l, o), jnp.arange(1, n))
                 return normalize(l, o)
 
-            def fstep(carry, s):
-                k_blk, v_blk, m, l, o = carry
+            def consume(k_blk, v_blk, s, m, l, o):
+                """One contiguous-placement block through the flash
+                core: self block in-block causal, earlier blocks full,
+                later blocks fully masked -> skip (the flash analogue
+                of `accumulate`). Shared by the scan body and the final
+                un-rotated block."""
                 kv_origin = (idx - s) % n
 
                 def self_tile(args):
@@ -255,37 +259,24 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     return flash_merge(q_loc, args[0], args[1], False,
                                        *args[2:])
 
-                if causal:
-                    # contiguous: self block in-block causal, earlier
-                    # blocks full, later blocks fully masked -> skip
-                    m, l, o = jax.lax.cond(
-                        kv_origin <= idx,
-                        lambda a: jax.lax.cond(kv_origin == idx,
-                                               self_tile, full_tile, a),
-                        lambda a: (a[2], a[3], a[4]),
-                        (k_blk, v_blk, m, l, o))
-                else:
-                    m, l, o = full_tile((k_blk, v_blk, m, l, o))
+                if not causal:
+                    return full_tile((k_blk, v_blk, m, l, o))
+                return jax.lax.cond(
+                    kv_origin <= idx,
+                    lambda a: jax.lax.cond(kv_origin == idx,
+                                           self_tile, full_tile, a),
+                    lambda a: (a[2], a[3], a[4]),
+                    (k_blk, v_blk, m, l, o))
+
+            def fstep(carry, s):
+                k_blk, v_blk, m, l, o = carry
+                m, l, o = consume(k_blk, v_blk, s, m, l, o)
                 k_blk, v_blk = rotate(k_blk, v_blk)
                 return (k_blk, v_blk, m, l, o), None
 
             (k_l, v_l, m, l, o), _ = jax.lax.scan(
                 fstep, (k_loc, v_loc, m0, l0, o0), jnp.arange(n - 1))
-            s_last = n - 1
-            kv_origin = (idx - s_last) % n
-            if causal:
-                m, l, o = jax.lax.cond(
-                    kv_origin <= idx,
-                    lambda a: jax.lax.cond(
-                        kv_origin == idx,
-                        lambda a: flash_merge(q_loc, a[0], a[1], True,
-                                              *a[2:]),
-                        lambda a: flash_merge(q_loc, a[0], a[1], False,
-                                              *a[2:]), a),
-                    lambda a: (a[2], a[3], a[4]),
-                    (k_l, v_l, m, l, o))
-            else:
-                m, l, o = flash_merge(q_loc, k_l, v_l, False, m, l, o)
+            m, l, o = consume(k_l, v_l, n - 1, m, l, o)
             return normalize(l, o)
 
         if causal and zigzag and n > 1:

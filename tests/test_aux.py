@@ -40,6 +40,25 @@ class TestCheckpoint:
         assert step2 == 11  # resumed from 10
         sess2.close()
 
+    def test_sync_save_knob_roundtrips(self, tmp_path, rng):
+        """CheckPointConfig.async_save=False: fully synchronous saves
+        (reference behavior) write and restore identically."""
+        ckpt_dir = str(tmp_path / "ckpt_sync")
+        cfg = parallax.Config(
+            run_option="AR", search_partitions=False,
+            ckpt_config=parallax.CheckPointConfig(ckpt_dir=ckpt_dir,
+                                                  save_ckpt_steps=4,
+                                                  async_save=False))
+        sess, *_ = parallax.parallel_run(simple.build_model(0.1),
+                                         parallax_config=cfg)
+        _run_steps(sess, rng, 8)
+        sess.close()
+        sess2, *_ = parallax.parallel_run(simple.build_model(0.1),
+                                          parallax_config=cfg)
+        _, step = _run_steps(sess2, rng, 1)
+        assert step == 9  # resumed from the synchronous step-8 save
+        sess2.close()
+
     def test_save_every_n_steps(self, tmp_path, rng):
         ckpt_dir = str(tmp_path / "ckpt2")
         cfg = parallax.Config(

@@ -234,3 +234,26 @@ def test_nmt_tp_trajectory_matches_dp():
     assert cross["wv"].sharding.shard_shape(cross["wv"].shape) == (
         D, D // 2)
     np.testing.assert_allclose(tp_losses, dp_losses, rtol=2e-3)
+
+
+@pytest.mark.slow
+def test_bert_tp_sp_trajectory_matches_tp():
+    """BERT TP×SP: seq-sharded resting activations train identically to
+    plain TP."""
+    def run(tp_sp):
+        cfg = bert.tiny_config(num_heads=4, compute_dtype=jnp.float32,
+                               tensor_parallel=True,
+                               tp_sequence_parallel=tp_sp)
+        sess, *_ = parallax.parallel_run(
+            bert.build_model(cfg),
+            parallax_config=parallax.Config(run_option="HYBRID",
+                                            search_partitions=False),
+            num_partitions=4)
+        r = np.random.default_rng(11)
+        batches = [bert.make_batch(r, 8, 32, 4, cfg.vocab_size)
+                   for _ in range(2)]
+        losses = [sess.run("loss", feed_dict=b) for b in batches]
+        sess.close()
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-3)
