@@ -97,7 +97,13 @@ class CheckpointHook:
         self._mngr.save(step, args=ocp.args.StandardSave(state),
                         force=True)
         self._last_save_time = time.time()
-        parallax_log.info("saved checkpoint at step %d", step)
+        if getattr(self._config, "async_save", True):
+            # async: the commit finishes on a background thread — the
+            # log must not claim durability the disk doesn't have yet
+            parallax_log.info("dispatched checkpoint save at step %d "
+                             "(async commit)", step)
+        else:
+            parallax_log.info("saved checkpoint at step %d", step)
         return True
 
     def restore(self, state_template):
