@@ -304,14 +304,15 @@ def worker_main():
                 "dense_allreduce_bytes": flag["dense_allreduce_bytes"],
                 "sparse_over_dense": flag["sparse_over_dense"],
             }
-            # the tuned configuration (bf16 row planes + overflow-free
-            # dedup capacity): 0.9% of the reference's fp32 dense
-            # all-reduce — see perf/WIRE_BYTES_r04.json for the full
-            # accounting
+            # the tuned configuration (bf16 row planes + per-table
+            # overflow-free dedup capacities): 0.65% of the reference's
+            # fp32 dense all-reduce — perf/WIRE_BYTES_r04.json has the
+            # full accounting
             opt = flagship_accounting(n_chips, table_dtype="bfloat16",
-                                      dedup_capacity=1792)
+                                      dedup_capacity="auto")
             result["flagship_wire_bytes_optimized"] = {
-                "table_dtype": "bfloat16", "dedup_capacity": 1792,
+                "table_dtype": "bfloat16",
+                "dedup_capacity": opt["config"]["dedup_capacity"],
                 "overflow_free":
                     opt["config"]["dedup_capacity_overflow_free"],
                 "sparse_path_bytes": opt["sparse_path_bytes"],

@@ -12,7 +12,7 @@ for observability.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Sequence
+from typing import Any, Dict, Optional, Sequence, Union
 
 from parallax_tpu.common import consts
 
@@ -66,7 +66,15 @@ class PSConfig:
     protocol: str = "grpc"
     replicate_variables: bool = True
     local_aggregation: bool = True
-    dedup_capacity: Optional[int] = None
+    # int: one capacity for every sharded lookup; dict: per-table
+    # capacities — keys are parameter PATHS (e.g. {"emb": 768,
+    # "softmax_w": 1792}; resolved in sparse_grad_mode="slices", where
+    # the lookup identifies its table) or table SHAPE tuples (fallback;
+    # beware same-shape tables collide). Input-id and label+candidate
+    # lookups have very different distinct-id profiles, so per-table
+    # declarations compress further at the same overflow margin.
+    # Unlisted tables use the automatic exactness bound.
+    dedup_capacity: Union[int, Dict[Any, int], None] = None
     cross_replica_sparse: Optional[bool] = None
     boundary_among_servers: bool = True
     boundary_between_workers_and_servers: bool = True

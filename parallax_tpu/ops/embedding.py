@@ -49,7 +49,7 @@ import contextlib
 import contextvars
 import dataclasses
 import functools
-from typing import Optional, Tuple
+from typing import Any, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -124,7 +124,9 @@ class _MeshCtx:
     # fall back to the exact uncompressed exchange via a mesh-uniform
     # lax.cond — declared capacity is a wire-size target, never a
     # correctness risk.
-    dedup_capacity_hint: Optional[int] = None
+    # int, or a dict keyed by parameter path / table-shape tuple
+    # (PSConfig.dedup_capacity contract)
+    dedup_capacity_hint: Union[int, Dict[Any, int], None] = None
     # Cross-replica table-grad combine: None = auto by bytes, True/False
     # forces sparse (gather deduped rows over the whole mesh) vs dense
     # ([rows/shard, dim] psum over 'repl') — see _choose_sparse_repl.
@@ -148,7 +150,8 @@ def sharded_lookup_scope(mesh: Mesh, sharded_shapes,
                          records: Optional[list] = None,
                          local_aggregation: bool = True,
                          slice_capture: Optional[SliceCapture] = None,
-                         dedup_capacity: Optional[int] = None,
+                         dedup_capacity: Union[int, Dict[Any, int],
+                                               None] = None,
                          cross_replica_sparse: Optional[bool] = None):
     """Engine-installed scope: inside it, ``embedding_lookup`` of a table
     whose shape is registered routes through the sharded collective path."""
@@ -222,9 +225,15 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
         if slice_path is not None:
             rows = ctx.slice_capture.attach(slice_path, ids, rows)
         return rows
+    cap_hint = ctx.dedup_capacity_hint
+    if (isinstance(cap_hint, dict) and slice_path is not None
+            and slice_path in cap_hint):
+        # per-PARAMETER capacity (slices mode identifies the table by
+        # path — shape keys can collide, e.g. emb and softmax_w are
+        # both [V, 512] in the flagship)
+        cap_hint = cap_hint[slice_path]
     cap, guarded = _dedup_capacity(table.shape, ids.shape, ctx.mesh,
-                                   ctx.local_aggregation,
-                                   ctx.dedup_capacity_hint)
+                                   ctx.local_aggregation, cap_hint)
     n = num_devices(ctx.mesh)
     n_dev = int(np.prod(ids.shape)) // n
     cap_eff = cap if cap is not None else n_dev
@@ -304,7 +313,7 @@ def _choose_sparse_repl(mesh, table_shape, cap_eff: int, counts: bool,
 
 def _dedup_capacity(table_shape, ids_shape, mesh,
                     local_aggregation: bool,
-                    hint: Optional[int] = None
+                    hint: Union[int, Dict[Any, int], None] = None
                     ) -> Tuple[Optional[int], bool]:
     """(static per-device unique-id slot count or None, guarded) for the
     two-stage combine; None when the combine is off or cannot reduce
@@ -322,11 +331,16 @@ def _dedup_capacity(table_shape, ids_shape, mesh,
     A user ``hint`` (PSConfig.dedup_capacity) may set the capacity BELOW
     that bound — then ``guarded=True`` and the lookup adds a runtime
     distinct-count check that falls back to the exact uncompressed
-    exchange on overflow (never lossy, see `_sharded_lookup`)."""
+    exchange on overflow (never lossy, see `_sharded_lookup`). The hint
+    may be a dict keyed by table shape tuple (different lookups have
+    very different distinct-id profiles: input ids vs labels+candidates)
+    — unlisted tables get the automatic bound."""
     if not local_aggregation:
         return None, False
     n_dev = int(np.prod(ids_shape)) // num_devices(mesh)
     bound = min(n_dev, int(table_shape[0]) + 1)
+    if isinstance(hint, dict):
+        hint = hint.get(tuple(table_shape))
     if hint is not None:
         cap = max(1, min(int(hint), bound))
         if cap >= n_dev:

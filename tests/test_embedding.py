@@ -385,3 +385,50 @@ def test_p1_degenerates_to_plain_take(table, ids):
             lambda t, i: embedding.embedding_lookup(t, i))(table, ids)
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(jnp.take(table, ids, axis=0)))
+
+
+class TestPerTableDedupCapacity:
+    def test_path_keyed_capacities_compress_only_named_tables(self, rng):
+        """PSConfig.dedup_capacity as a path-keyed dict (slices mode):
+        the named table ships its declared capacity, unlisted tables
+        keep the automatic bound — and the trajectory still matches the
+        undeclared run (the guarded combine is exact)."""
+        import parallax_tpu as parallax
+        from parallax_tpu.models import lm1b
+
+        batches = [lm1b.make_batch(rng, 16, 8, 1000) for _ in range(3)]
+
+        def run(cap):
+            cfg = lm1b.tiny_config(num_partitions=8,
+                                   sparse_grad_mode="slices")
+            comm = parallax.CommunicationConfig(
+                ps_config=parallax.PSConfig(dedup_capacity=cap))
+            sess, *_ = parallax.parallel_run(
+                lm1b.build_model(cfg),
+                parallax_config=parallax.Config(
+                    run_option="HYBRID", search_partitions=False,
+                    sparse_grad_mode="slices",
+                    communication_config=comm))
+            losses = [float(sess.run("loss", feed_dict=b))
+                      for b in batches]
+            recs = sess.engine.sparse_wire_bytes_per_step()["per_lookup"]
+            sess.close()
+            return losses, recs
+
+        base_losses, base_recs = run(None)
+        dict_losses, dict_recs = run({"emb": 8})
+
+        # tiny config: 16 ids/device on emb; declaring 8 halves the
+        # emb exchange while softmax lookups keep the automatic bound.
+        # (Identify the emb record by its declared capacity — emb and
+        # softmax_w share shape (V, 32) in tiny_config, so shape-based
+        # selection would be ambiguous.)
+        by_ids = sorted(r["ids_on_wire"] for r in base_recs)
+        by_ids_d = sorted(r["ids_on_wire"] for r in dict_recs)
+        assert sum(by_ids_d) < sum(by_ids), (by_ids, by_ids_d)
+        at_cap = [r for r in dict_recs if r["ids_on_wire"] == 8 * 8]
+        assert len(at_cap) == 1, by_ids_d
+        assert not any(r["ids_on_wire"] == 8 * 8 for r in base_recs), \
+            by_ids
+        # exactness: guarded capacity never changes the math
+        np.testing.assert_allclose(dict_losses, base_losses, rtol=1e-4)

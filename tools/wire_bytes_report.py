@@ -65,16 +65,40 @@ def flagship_accounting(n_chips: int = 8, batch_per_chip: int = 128,
                             batch_per_chip * n_chips, num_steps,
                             cfg.vocab_size)
     overflow_free = None
-    if dedup_capacity is not None:
-        # exactness check on the host: every lookup's per-device
-        # distinct-id count must fit the declared capacity. emb gathers
-        # the input ids; the softmax lookup gathers labels + its
-        # 1/n_chips slice of the log-uniform candidates (distinct count
-        # upper-bounded by labels-distinct + slice length).
-        def max_distinct(arr):
-            return max(len(np.unique(c))
-                       for c in np.split(arr.reshape(-1), n_chips))
 
+    def max_distinct(arr):
+        return max(len(np.unique(c))
+                   for c in np.split(arr.reshape(-1), n_chips))
+
+    if dedup_capacity == "auto":
+        # Per-table capacities from the REAL distinct-id profile of the
+        # seeded batch (+ two 128-blocks of margin), per lookup: the emb
+        # table gathers input ids (Zipf, heavy duplication); the softmax
+        # tables gather labels + a 1/n_chips slice of the log-uniform
+        # candidates (distinct count upper-bounded by labels-distinct +
+        # slice length). The runtime lax.cond guard keeps any
+        # out-of-profile step exact regardless.
+        def padded(b):
+            return (b // 128 + 2) * 128
+
+        emb_cap = padded(max_distinct(batch["x"]))
+        sm_cap = padded(max_distinct(batch["y"])
+                        + cfg.num_samples // n_chips)
+        # path keys: emb and softmax_w share a shape in the flagship
+        dedup_capacity = {"emb": emb_cap, "softmax_w": sm_cap,
+                          "softmax_b": sm_cap}
+        overflow_free = True  # by construction, for the measured batch
+    elif isinstance(dedup_capacity, dict):
+        # round-trip of an 'auto'-style dict: check each declared table
+        # against its own lookup's distinct-id bound
+        emb_bound = max_distinct(batch["x"])
+        sm_bound = (max_distinct(batch["y"])
+                    + cfg.num_samples // n_chips)
+        bounds = {"emb": emb_bound, "softmax_w": sm_bound,
+                  "softmax_b": sm_bound}
+        overflow_free = all(
+            bounds.get(k, 0) <= v for k, v in dedup_capacity.items())
+    elif dedup_capacity is not None:
         bound = max(max_distinct(batch["x"]),
                     max_distinct(batch["y"])
                     + cfg.num_samples // n_chips)
@@ -131,11 +155,17 @@ def main():
     ap.add_argument("--batch_per_chip", type=int, default=128)
     ap.add_argument("--table_dtype", default="float32",
                     choices=["float32", "bfloat16"])
-    ap.add_argument("--dedup_capacity", type=int, default=None)
+    ap.add_argument("--dedup_capacity", default=None,
+                    help="per-device unique-id slots: an int, or 'auto' "
+                         "for per-table capacities from the measured "
+                         "distinct-id profile")
     args = ap.parse_args()
+    cap = args.dedup_capacity
+    if cap is not None and cap != "auto":
+        cap = int(cap)
     result = flagship_accounting(args.n_chips, args.batch_per_chip,
                                  table_dtype=args.table_dtype,
-                                 dedup_capacity=args.dedup_capacity)
+                                 dedup_capacity=cap)
     line = json.dumps(result)
     print(line)
     if args.out:
