@@ -46,6 +46,10 @@ def main():
                     help="'slices' = reference IndexedSlices semantics "
                          "(tables outside the clip, scatter-only "
                          "adagrad) and the fast TPU path")
+    ap.add_argument("--lstm_impl", default="xla",
+                    choices=["xla", "pallas"],
+                    help="'pallas' = VMEM-resident recurrence kernel "
+                         "(ops/pallas_lstm.py)")
     args = ap.parse_args()
 
     num_partitions = parallax.get_partitioner(args.partitions)
@@ -53,7 +57,8 @@ def main():
         vocab_size=args.vocab_size, emb_dim=args.emb_dim,
         hidden_dim=args.hidden_dim, proj_dim=args.proj_dim,
         num_samples=args.num_samples, num_partitions=num_partitions,
-        sparse_grad_mode=args.sparse_grad_mode)
+        sparse_grad_mode=args.sparse_grad_mode,
+        lstm_impl=args.lstm_impl)
     model = lm1b.build_model(cfg)
     config = parallax.Config(
         run_option=args.run_option,

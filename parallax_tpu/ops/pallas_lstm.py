@@ -1,0 +1,195 @@
+"""Pallas LSTM scan — the flagship LM1B's hot op, VMEM-resident.
+
+The LM1B forward is dominated by the recurrent gate matmul
+[B, E+P] x [E+P, 4H] under `lax.scan` (models/lm1b.py). XLA compiles the
+scan body once and re-fetches the gate matrix from HBM every time step:
+at the flagship size that is 16.8 MB (bf16, [1024, 8192]) x T=20 steps
+= 335 MB of HBM traffic per step for 16.8 MB of actual weights. This
+kernel runs the WHOLE time loop inside one pallas program with the
+weights (and the h/c state) resident in VMEM — weights are fetched once
+per batch tile, an ~T-fold traffic cut on the scan's dominant term.
+
+**Size constraint:** the gate matrix is kept as ONE VMEM block, so the
+kernel only compiles when it fits alongside the x/out tiles (~16 MB
+VMEM per TensorCore); `lstm_scan` raises with a clear message beyond a
+conservative budget. The flagship's bf16 gate matrix (16.8 MB) just
+misses — gate-dimension tiling is the known follow-up (ROADMAP item
+17); until then the kernel serves sub-flagship recurrences and the
+fp32-vs-bf16 measurement harness.
+
+Backward: recompute-based — a `jax.custom_vjp` whose backward
+differentiates the identical pure-XLA scan (`lstm_scan_reference`) at
+the same inputs. The forward pays Pallas prices, the backward pays one
+extra forward (the standard remat trade; the engine's remat story for
+transformer blocks is the same), and gradients are exactly the XLA
+scan's.
+
+Reference parity: the cell math is models/lm1b.py's fused-gate LSTM
+(reference examples/lm1b/language_model.py LSTM with projection);
+enable per model via ``LM1BConfig.lstm_impl='pallas'``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def lstm_scan_reference(x_seq, w, b, w_proj):
+    """Pure-XLA scan with the KERNEL's exact numerics: matmuls take the
+    weights' dtype with fp32 accumulation and the (c, h) carry stays
+    fp32 whatever the input dtype. This is the function the custom_vjp
+    backward differentiates, so it must match the Pallas forward
+    bit-for-bit in semantics — it deliberately differs from
+    models/lm1b.lstm_scan's plain compute-dtype scan (bf16 carries
+    there; the kernel's fp32 carry is strictly more precise)."""
+    T, B, E = x_seq.shape
+    H = w.shape[1] // 4
+    P = w_proj.shape[1]
+    b32 = b.astype(jnp.float32)
+
+    def cell(carry, x_t):
+        c, h = carry                                   # fp32
+        zx = jnp.concatenate([x_t.astype(jnp.float32), h], axis=-1)
+        gates = jax.lax.dot_general(
+            zx.astype(w.dtype), w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) + b32
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_full = jax.nn.sigmoid(o) * jnp.tanh(c)
+        h = jax.lax.dot_general(
+            h_full.astype(w_proj.dtype), w_proj,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return (c, h), h.astype(x_seq.dtype)
+
+    c0 = jnp.zeros((B, H), jnp.float32)
+    h0 = jnp.zeros((B, P), jnp.float32)
+    (_, _), hs = jax.lax.scan(cell, (c0, h0), x_seq)
+    return hs
+
+
+def _lstm_kernel(x_ref, w_ref, b_ref, wp_ref, out_ref, *, T: int):
+    w = w_ref[...]                                   # [E+P, 4H]
+    b = b_ref[...]                                   # [4H]
+    wp = wp_ref[...]                                 # [H, P]
+    bt = x_ref.shape[1]
+    H = w.shape[1] // 4
+    P = wp.shape[1]
+    c0 = jnp.zeros((bt, H), jnp.float32)
+    h0 = jnp.zeros((bt, P), jnp.float32)
+
+    def body(t, carry):
+        c, h = carry
+        x_t = x_ref[pl.dslice(t, 1)][0]               # [bt, E]
+        zx = jnp.concatenate([x_t.astype(jnp.float32), h], axis=-1)
+        gates = jax.lax.dot_general(
+            zx.astype(w.dtype), w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) + b.astype(jnp.float32)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = (jax.nn.sigmoid(f + 1.0) * c
+             + jax.nn.sigmoid(i) * jnp.tanh(g))
+        h_full = jax.nn.sigmoid(o) * jnp.tanh(c)
+        h = jax.lax.dot_general(
+            h_full.astype(wp.dtype), wp, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out_ref[pl.dslice(t, 1)] = h.astype(out_ref.dtype)[None]
+        return c, h
+
+    jax.lax.fori_loop(0, T, body, (c0, h0))
+
+
+def _forward(x_seq, w, b, w_proj, batch_tile: int, interpret: bool):
+    T, B, E = x_seq.shape
+    P = w_proj.shape[1]
+    bt = min(batch_tile, B)
+    while B % bt:
+        bt -= 1
+    grid = (B // bt,)
+    return pl.pallas_call(
+        functools.partial(_lstm_kernel, T=T),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((T, bt, E), lambda i: (0, i, 0)),
+            pl.BlockSpec(w.shape, lambda i: (0, 0)),
+            pl.BlockSpec(b.shape, lambda i: (0,)),
+            pl.BlockSpec(w_proj.shape, lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((T, bt, P), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((T, B, P), x_seq.dtype),
+        interpret=interpret,
+    )(x_seq, w, b, w_proj)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _lstm_scan_pallas(x_seq, w, b, w_proj, batch_tile, interpret):
+    return _forward(x_seq, w, b, w_proj, batch_tile, interpret)
+
+
+def _fwd(x_seq, w, b, w_proj, batch_tile, interpret):
+    out = _forward(x_seq, w, b, w_proj, batch_tile, interpret)
+    return out, (x_seq, w, b, w_proj)
+
+
+def _bwd(batch_tile, interpret, res, g):
+    x_seq, w, b, w_proj = res
+    # recompute-based backward: differentiate the identical XLA scan at
+    # the same inputs (one extra forward, exact XLA gradients)
+    _, vjp = jax.vjp(lstm_scan_reference, x_seq, w, b, w_proj)
+    return vjp(g.astype(x_seq.dtype))
+
+
+_lstm_scan_pallas.defvjp(_fwd, _bwd)
+
+
+def lstm_scan(x_seq, w, b, w_proj, *, impl: str = "xla",
+              batch_tile: int = 128,
+              interpret: Optional[bool] = None,
+              mesh=None, batch_axes=None):
+    """Fused-gate LSTM scan, x_seq [T, B, E] -> hs [T, B, P].
+
+    ``impl='pallas'`` runs the VMEM-resident kernel (forward) with the
+    recompute-XLA backward; ``'xla'`` is the plain scan. ``interpret``
+    defaults to True off-TPU so CPU tests exercise the kernel.
+
+    Under GSPMD a pallas custom call does not partition — pass ``mesh``
+    + ``batch_axes`` (the mesh axes B is sharded over) and the kernel
+    runs per-device under shard_map (weights replicated in, gradients
+    psum'd by the transpose), keeping the batch sharding intact."""
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown lstm impl {impl!r}")
+    if impl == "xla":
+        return lstm_scan_reference(x_seq, w, b, w_proj)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # the gate matrix lives as one VMEM block — refuse sizes that cannot
+    # compile on hardware instead of failing deep inside Mosaic
+    w_bytes = int(np.prod(w.shape)) * jnp.dtype(w.dtype).itemsize
+    budget = int(os.environ.get("PARALLAX_LSTM_VMEM_BUDGET",
+                                12 * 1024 * 1024))
+    if not interpret and w_bytes > budget:
+        raise ValueError(
+            f"pallas lstm: gate matrix is {w_bytes / 1e6:.1f} MB, over "
+            f"the {budget / 1e6:.0f} MB VMEM budget — use impl='xla' "
+            f"(or a smaller hidden size) until gate-dim tiling lands")
+
+    def run(x_seq, w, b, w_proj):
+        return _lstm_scan_pallas(x_seq, w, b, w_proj, int(batch_tile),
+                                 bool(interpret))
+
+    if mesh is None or batch_axes is None:
+        return run(x_seq, w, b, w_proj)
+    from jax.sharding import PartitionSpec as P
+    return jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(P(None, batch_axes, None), P(), P(), P()),
+        out_specs=P(None, batch_axes, None),
+        # pallas interpret mode trips the VMA checker (see
+        # ops/ring_attention.py — jax's own suggested workaround)
+        check_vma=not interpret)(x_seq, w, b, w_proj)
