@@ -43,8 +43,16 @@ def main():
     ap.add_argument("--pallas_attention", action="store_true",
                     help="fuse attention with the Pallas flash kernel "
                          "(data/tensor modes)")
-    ap.add_argument("--zigzag", action="store_true",
-                    help="balanced causal placement for ring mode")
+    # Tri-state on purpose: omitting the flag leaves zigzag=None so the
+    # config's auto heuristic picks balanced placement for causal ring
+    # attention (ADVICE r4: a store_true default-False here silently
+    # forced contiguous placement, making the auto default unreachable
+    # from the only user-facing ring entry point).
+    ap.add_argument("--zigzag", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="balanced causal placement for ring mode "
+                         "(default: auto — zigzag when causal; "
+                         "--zigzag/--no-zigzag force)")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize blocks in the backward "
                          "(jax.checkpoint): O(1)-block activations")

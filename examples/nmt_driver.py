@@ -38,8 +38,16 @@ def main():
     ap.add_argument("--src_file", default=None)
     ap.add_argument("--tgt_file", default=None)
     args = ap.parse_args()
-    if args.src_file and not (args.vocab_file and args.tgt_file):
-        ap.error("--src_file requires --vocab_file and --tgt_file")
+    # the three file flags only make sense as a group: a partial set
+    # used to fall back silently to the synthetic corpus, which looks
+    # exactly like a successful file-based run (ADVICE r4)
+    file_flags = {"--vocab_file": args.vocab_file,
+                  "--src_file": args.src_file,
+                  "--tgt_file": args.tgt_file}
+    if any(file_flags.values()) and not all(file_flags.values()):
+        missing = [k for k, v in file_flags.items() if not v]
+        ap.error("file-based data needs --vocab_file, --src_file and "
+                 f"--tgt_file together (missing: {', '.join(missing)})")
 
     num_partitions = parallax.get_partitioner(args.partitions)
     vocab, batches = None, None

@@ -48,7 +48,7 @@ class CheckpointHook:
                 max_to_keep=None,  # reference keeps everything
                                    # (max_to_keep=1000000, lib.py:44)
                 enable_async_checkpointing=bool(
-                    getattr(self._config, "async_save", True)))
+                    getattr(self._config, "async_save", False)))
             self._mngr = ocp.CheckpointManager(
                 os.path.abspath(self._config.ckpt_dir), options=opts)
 
@@ -97,7 +97,7 @@ class CheckpointHook:
         self._mngr.save(step, args=ocp.args.StandardSave(state),
                         force=True)
         self._last_save_time = time.time()
-        if getattr(self._config, "async_save", True):
+        if getattr(self._config, "async_save", False):
             # async: the commit finishes on a background thread — the
             # log must not claim durability the disk doesn't have yet
             parallax_log.info("dispatched checkpoint save at step %d "

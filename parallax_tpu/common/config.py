@@ -117,8 +117,12 @@ class CheckPointConfig:
     # host transfers and returns, with serialization/commit on a
     # background thread while training continues — the step never blocks
     # on storage. Session close / the next save waits for the previous
-    # commit. False = fully synchronous saves (reference behavior).
-    async_save: bool = True
+    # commit. Default False = fully synchronous saves, matching the
+    # reference's durability guarantee (a crash between an async
+    # dispatch and its background commit would lose the most recent
+    # "saved" checkpoint — opting into that weaker guarantee should be
+    # explicit; ADVICE r4).
+    async_save: bool = False
 
 
 @dataclasses.dataclass

@@ -43,8 +43,18 @@ class Vocab:
         # the reference's check_vocab writes a corrected copy instead;
         # same semantics, no file churn)
         if toks[:len(_SPECIALS)] != list(_SPECIALS):
+            n_present = sum(t in _SPECIALS for t in toks)
             toks = [t for t in _SPECIALS] + [
                 t for t in toks if t not in _SPECIALS]
+            # the remap shifts every token id relative to the file's
+            # line numbers; unlike the reference we don't rewrite the
+            # file, so externally pre-encoded data keyed by line index
+            # would silently mislabel — say so (ADVICE r4)
+            import logging
+            logging.getLogger("parallax").warning(
+                "vocab: %d special token(s) prepended, %d moved to ids "
+                "0-3; token ids no longer match the file's line "
+                "numbers", len(_SPECIALS) - n_present, n_present)
         self.id_to_token: List[str] = toks
         self.token_to_id: Dict[str, int] = {
             t: i for i, t in enumerate(toks)}

@@ -40,6 +40,27 @@ class TestCheckpoint:
         assert step2 == 11  # resumed from 10
         sess2.close()
 
+    def test_async_save_knob_roundtrips(self, tmp_path, rng):
+        """CheckPointConfig.async_save=True (opt-in since r5; the
+        default is synchronous for reference durability parity): the
+        background commit must be awaited by close() and restore
+        identically."""
+        ckpt_dir = str(tmp_path / "ckpt_async")
+        cfg = parallax.Config(
+            run_option="AR", search_partitions=False,
+            ckpt_config=parallax.CheckPointConfig(ckpt_dir=ckpt_dir,
+                                                  save_ckpt_steps=4,
+                                                  async_save=True))
+        sess, *_ = parallax.parallel_run(simple.build_model(0.1),
+                                         parallax_config=cfg)
+        _run_steps(sess, rng, 8)
+        sess.close()  # waits for the background commit
+        sess2, *_ = parallax.parallel_run(simple.build_model(0.1),
+                                          parallax_config=cfg)
+        _, step = _run_steps(sess2, rng, 1)
+        assert step == 9  # resumed from the async step-8 save
+        sess2.close()
+
     def test_sync_save_knob_roundtrips(self, tmp_path, rng):
         """CheckPointConfig.async_save=False: fully synchronous saves
         (reference behavior) write and restore identically."""
