@@ -261,9 +261,12 @@ def worker_main():
         bs, T, steps, warmup = 128 * n_chips, 20, 30, 5
         # slices mode: table grads stay (ids, rows) pairs end-to-end —
         # the reference's IndexedSlices processing and the fast path on
-        # TPU (no dense [V, D] cotangent / accumulator pass per step)
+        # TPU (no dense [V, D] cotangent / accumulator pass per step).
+        # lstm_impl='pallas': the r5 hoisted-input/resident-recurrent
+        # kernel serves the flagship (ROADMAP item 17) — default on TPU.
         cfg = lm1b.LM1BConfig(num_partitions=n_chips,
-                              sparse_grad_mode="slices")
+                              sparse_grad_mode="slices",
+                              lstm_impl="pallas")
         # full softmax materializes [B*T, 793k] logits; per-chip batch 16
         # is the largest that fits alongside params+opt state in HBM
         small_bs = 16 * n_chips
