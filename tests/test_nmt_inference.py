@@ -115,3 +115,33 @@ def test_train_decode_bleu_roundtrip(tmp_path):
     assert bleu_b > 90.0, (bleu_b, hyps_b[:2])
     # sanity: the decodes actually reproduce the source tokens
     assert hyps_g[0] == [str(t) for t in batch["src"][0]]
+
+
+def test_beam_decode_exercises_cached_path_and_matches_cacheless(rng):
+    """VERDICT r4 next item 8: beam mode really runs the KV-cached
+    incremental step (counted at trace time), and its output equals the
+    cache-less reference loop's."""
+    from unittest import mock
+
+    cfg = nmt.tiny_config(max_len=24)
+    params = nmt.build_model(cfg).init_fn(jax.random.PRNGKey(0))
+    import jax.numpy as jnp
+    src = jnp.asarray(rng.integers(4, cfg.vocab_size, (3, 10)),
+                      jnp.int32)
+
+    real = nmt._decode_step_cached
+    calls = {"n": 0}
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    with mock.patch.object(nmt, "_decode_step_cached", counting):
+        cached = np.asarray(nmt.beam_decode(params, cfg, src,
+                                            beam_width=3,
+                                            use_cache=True))
+    assert calls["n"] > 0, "beam use_cache=True never hit the cached step"
+    cacheless = np.asarray(nmt.beam_decode(params, cfg, src,
+                                           beam_width=3,
+                                           use_cache=False))
+    np.testing.assert_array_equal(cached, cacheless)

@@ -1,0 +1,72 @@
+"""KV-cache decode evidence (VERDICT r4 next item 8): time the cached
+vs cache-less NMT greedy decode at several target lengths and write
+``perf/NMT_DECODE_r05.json``.
+
+The cache-less loop re-runs the causal decoder over the whole [T]
+buffer per emitted token (O(T^2) total attention work); the cached path
+(models/nmt.py:226-289) computes each new token against per-layer K/V
+caches (O(T) total). Reference analogue:
+``/root/reference/parallax/parallax/examples/nmt/inference.py`` decodes
+through tf.while_loop with the attention wrapper's state — the cached
+formulation. CPU timings (compile excluded) are structure, not
+hardware: the ratio's growth with T is the O(T) vs O(T^2) signature.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(lengths=(32, 64, 128), batch=4, repeats=3):
+    import jax
+    import numpy as np
+
+    from parallax_tpu.models import nmt
+
+    cfg = nmt.tiny_config(max_len=max(lengths))
+    params = nmt.build_model(cfg).init_fn(jax.random.PRNGKey(0))
+    # params come from Model.init_fn as host arrays; decode fns jit
+    rng = np.random.default_rng(0)
+    src = rng.integers(4, cfg.vocab_size, (batch, 16)).astype(np.int32)
+
+    rows = []
+    for T in lengths:
+        entry = {"target_len": int(T), "batch": batch}
+        for use_cache, key in ((True, "cached_ms"), (False, "cacheless_ms")):
+            fn = jax.jit(lambda p, s: nmt.greedy_decode(
+                p, cfg, s, max_len=T, use_cache=use_cache))
+            out = fn(params, src)               # compile + warm
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                jax.block_until_ready(fn(params, src))
+            entry[key] = round((time.perf_counter() - t0) / repeats
+                               * 1000, 2)
+        entry["cacheless_over_cached"] = round(
+            entry["cacheless_ms"] / entry["cached_ms"], 2)
+        rows.append(entry)
+        print(entry, flush=True)
+
+    ratios = [r["cacheless_over_cached"] for r in rows]
+    result = {
+        "what": "NMT greedy decode wall time, cached (O(T)) vs "
+                "cache-less (O(T^2)) — models/nmt.py",
+        "platform": jax.devices()[0].platform,
+        "model": "nmt.tiny_config",
+        "rows": rows,
+        # the O(T) vs O(T^2) signature: the advantage grows with T
+        "ratio_grows_with_T": bool(all(
+            b >= a for a, b in zip(ratios, ratios[1:]))),
+    }
+    out_path = os.path.join(os.path.dirname(__file__), "..", "perf",
+                            "NMT_DECODE_r05.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()
