@@ -167,7 +167,13 @@ def is_local_host(hostname: str) -> bool:
     resource file listing the master's real hostname must not make the
     master ssh to itself or take the remote pid-file kill path for a
     local child (the reference had exactly that wart)."""
-    if hostname in ("localhost", "127.0.0.1", "::1"):
+    if hostname in ("localhost", "::1"):
+        return True
+    # ALL of 127/8 is the loopback network on Linux — resource files can
+    # name 127.0.0.2/127.0.0.3/... to run several local workers (the
+    # duplicate-host check in parse_resource_info requires distinct
+    # names; the N-process CPU rigs in tests/multihost_*.py use this)
+    if hostname.startswith("127."):
         return True
     import socket
     try:

@@ -202,3 +202,72 @@ def test_two_process_sparse_cross_replica_combine(tmp_path):
         ref.append(float(sess.run("loss", feed_dict=batch)))
     sess.close()
     np.testing.assert_allclose(losses[0], ref, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_four_process_sparse_combine_elastic_restart(tmp_path):
+    """VERDICT r4 next item 5: the N-machine case — repl=4 crossing
+    THREE process boundaries (4 processes x 2 devices), hybrid sparse
+    cross-replica combine AND an elastic kill/restart on the same
+    topology. Worker 3 dies on attempt 0 after the first checkpoint;
+    the relaunch resumes and the completed, per-step-seeded trajectory
+    must match an uninterrupted single-process run on the same mesh
+    shape."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out = str(tmp_path / "fourproc")
+    ckpt = str(tmp_path / "ckpt4")
+    env = dict(os.environ)
+    env.update({
+        "PARALLAX_COORDINATOR_PORT": str(port),
+        "PARALLAX_MAX_RESTARTS": "1",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": os.getcwd() + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.pop("PARALLAX_RUN_OPTION", None)
+    proc = subprocess.run(
+        [sys.executable, "tests/multihost_4proc_driver.py", out, ckpt],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+
+    from tests import multihost_4proc_driver as drv
+    results = {}
+    for wid in range(drv.NUM_WORKERS):
+        path = f"{out}.worker{wid}"
+        assert os.path.exists(path), (
+            f"worker {wid} left no result; master stderr:\n"
+            + proc.stderr[-3000:])
+        lines = open(path).read().splitlines()
+        meta = dict(kv.split("=") for kv in lines[0].split())
+        # the completed run is the relaunch, resumed from the ckpt
+        assert meta["attempt"] == "1", meta
+        assert int(meta["first_step"]) == drv.CKPT_EVERY + 1, meta
+        results[wid] = [(int(s), float(l))
+                        for s, l in (ln.split() for ln in lines[1:])]
+    # all four processes agree on the trajectory
+    assert all(results[w] == results[0]
+               for w in range(1, drv.NUM_WORKERS)), results
+    assert results[0][-1][0] == drv.STEPS, results[0]
+
+    # uninterrupted single-process reference on the SAME mesh shape
+    # (conftest gives this process 8 virtual devices -> [repl=4, shard=2])
+    import numpy as np
+    import parallax_tpu as parallax
+    from parallax_tpu.models import lm1b
+    cfg = lm1b.tiny_config(num_partitions=drv.NUM_PARTITIONS)
+    sess, *_ = parallax.parallel_run(
+        lm1b.build_model(cfg),
+        parallax_config=parallax.Config(run_option="HYBRID",
+                                        search_partitions=False),
+        num_partitions=drv.NUM_PARTITIONS)
+    ref = {}
+    for step in range(1, drv.STEPS + 1):
+        ref[step] = float(sess.run("loss",
+                                   feed_dict=drv.global_batch(step)))
+    sess.close()
+    got = dict(results[0])
+    for step, loss in got.items():
+        np.testing.assert_allclose(loss, ref[step], rtol=1e-4,
+                                   err_msg=f"step {step}")
