@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import signal
 import sys
+import time as _time
 from typing import List, Sequence
 
 from parallax_tpu.common import consts
@@ -176,7 +177,6 @@ def _run_cluster_once(hosts: Sequence[HostInfo],
         # worker dies (the reference master only watched the chief,
         # runner.py:124, leaving half-dead clusters hanging; the search
         # loop then misread deaths, partitions.py:122-128).
-        import time as _time
         while True:
             rc = chief.poll()
             if rc is not None:
@@ -219,9 +219,18 @@ def _run_cluster_once(hosts: Sequence[HostInfo],
             t.start()
         for t in killers:
             t.join(timeout=60)
+        # Grace period before SIGKILL: a worker blocked in a collective
+        # whose peer just died ignores SIGINT until the op times out, so
+        # on the ABORT path (a worker failed — nothing left to save;
+        # Orbax checkpoint commits are atomic, so killing mid-save only
+        # discards the uncommitted attempt) escalate fast instead of
+        # paying up to 30 s per surviving worker per attempt. Clean and
+        # user-interrupted teardowns keep the long grace.
+        grace = 30.0 if (rc in (0, None) or user_interrupt) else 5.0
+        deadline = _time.time() + grace
         for _, p in procs:
             try:
-                p.wait(timeout=30)
+                p.wait(timeout=max(0.1, deadline - _time.time()))
             except Exception:
                 p.kill()
     return rc, user_interrupt

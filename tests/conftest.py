@@ -23,14 +23,22 @@ jax.config.update("jax_platforms", "cpu")
 # their wall time back. Keyed by HLO + compile env, so a stale cache can
 # only miss, never corrupt. Disable with PARALLAX_JIT_CACHE=0.
 if os.environ.get("PARALLAX_JIT_CACHE", "1") != "0":
+    _cache_dir = os.environ.get(
+        "PARALLAX_JIT_CACHE_DIR",
+        os.path.abspath(os.path.join(os.path.dirname(__file__), "..",
+                                     ".jax_cache")))
     try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get("PARALLAX_JIT_CACHE_DIR",
-                           os.path.join(os.path.dirname(__file__), "..",
-                                        ".jax_cache")))
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           0.5)
+        # export to os.environ so SUBPROCESS drivers (test_multihost.py
+        # spawns 2-4 jax processes per test via dict(os.environ)) share
+        # the cache too — without this every multihost test recompiled
+        # every engine in every worker on every run (r5, suite-time
+        # item: the drivers were the dominant cold cost)
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+        os.environ.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
     except Exception:  # older jax without the knobs: run uncached
         pass
 import numpy as np  # noqa: E402

@@ -104,8 +104,18 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
+    # key renamed from 'cpu_sanity' (r4): a serialized host executes the
+    # SUM of per-device work, which is equal under both placements, so
+    # these timings cannot confirm the balance win — they are a PARITY
+    # check only (VERDICT r4 weak item 5). The zigzag decision rests on
+    # the analytic per-rotation-max model; the host_ms fields are
+    # incidental and the win is only measurable on parallel hardware.
+    parity = measure()
+    parity["note"] = ("numerics parity only; serialized-host timings "
+                      "cannot evidence the balance win (equal total "
+                      "work both ways)")
     result = {"analytic_n8": analytic(8), "analytic_n64": analytic(64),
-              "cpu_sanity": measure()}
+              "cpu_parity_check": parity}
     line = json.dumps(result)
     print(line)
     if args.out:
