@@ -50,11 +50,13 @@ print('matmul ok in %.1fs' % (time.time() - t0), flush=True)
     brc=$?
     tail -1 /tmp/bench_tpu_out.log > perf/BENCH_TPU_r05.json
     echo "bench rc=$brc -> perf/BENCH_TPU_r05.json" >> "$LOG"
-    # 4. profile
+    # 4. profile + the second named baseline metric (resnet50)
     if [ -f tools/profile_lm1b.py ]; then
       timeout 2400 python tools/profile_lm1b.py > perf/PROFILE_LM1B_r05.json 2>> "$LOG"
       echo "profile rc=$? -> perf/PROFILE_LM1B_r05.json" >> "$LOG"
     fi
+    timeout 2400 python tools/bench_resnet.py >> "$LOG" 2>&1
+    echo "resnet bench rc=$? -> perf/BENCH_RESNET_r05.json" >> "$LOG"
     git add -A perf/ && git commit -m "perf: TPU bench + profile artifacts" >> "$LOG" 2>&1
     echo "=== capture complete $(date '+%F %T') ===" >> "$LOG"
     exit 0
