@@ -238,7 +238,9 @@ def lstm_scan(x_seq, w, b, w_proj, *, impl: str = "xla",
     # so size the tile to the per-device batch)
     n_shards = 1
     if mesh is not None and batch_axes is not None:
-        n_shards = int(np.prod([mesh.shape[a] for a in batch_axes]))
+        axes = ((batch_axes,) if isinstance(batch_axes, str)
+                else tuple(batch_axes))
+        n_shards = int(np.prod([mesh.shape[a] for a in axes]))
     bt = _vmem_fit_batch_tile(batch_tile, max(1, B // n_shards), E, H, P,
                               w.dtype, x_seq.dtype, budget)
     if not interpret and bt is None:

@@ -114,6 +114,15 @@ class ParallaxSession:
 
     # -- the patched-run equivalent ---------------------------------------
 
+    def prepare(self, feed_dict: Dict[str, Any]) -> int:
+        """Build the engine (and restore any configured checkpoint)
+        from an example batch WITHOUT running a step; returns the
+        restored global step (0 on a fresh run). Lets callers read
+        ``state``/``engine``/the mesh — or seed per-step data correctly
+        on an elastic resume — before the first training step."""
+        self._ensure_engine(self._convert_feed(feed_dict))
+        return int(self._state.step)
+
     def run(self, fetches: Union[None, str, Sequence[str]] = None,
             feed_dict: Optional[Dict[str, Any]] = None):
         if feed_dict is None:

@@ -64,8 +64,7 @@ def main():
     # build the engine (and restore any checkpoint) WITHOUT running a
     # step, so the first real step's batch can be seeded by its true
     # global step even on the resumed attempt
-    sess._ensure_engine(sess._convert_feed(local(global_batch(1))))
-    start = int(sess.state.step)
+    start = sess.prepare(local(global_batch(1)))
 
     # (a) mesh topology: [repl=4, shard=2]; every shard ring lives
     # inside ONE process; 'repl' crosses three process boundaries
