@@ -215,9 +215,12 @@ def _run(model, cfg, batch_size, num_steps, steps, warmup, run_option,
         jax.block_until_ready(sess.state.params)
         # Steady-state loop: steps dispatch back-to-back (fetch nothing
         # per step — a scalar fetch is a host<->device round trip that
-        # serializes dispatch); block once at the end. The words count
-        # equals the feed's weight sum — the same value the "words"
-        # metric computes on device.
+        # serializes dispatch); block once at the end. One long window:
+        # splitting into best-of-k windows was tried (r5) and REJECTED —
+        # the per-window pipeline drain cost more than host-interference
+        # noise on every backend. The words count equals the feed's
+        # weight sum — the same value the "words" metric computes on
+        # device.
         t0 = time.perf_counter()
         words = 0.0
         for i in range(steps):
@@ -280,15 +283,18 @@ def worker_main():
     # if it doesn't fit rather than losing the whole headline.
     vs_baseline = None
     try_bs = small_bs
+    # r5: the comparison pair runs at least 12 steps each — at the old
+    # max(5, steps//3) the short full-softmax window made vs_baseline
+    # swing ±15% run-to-run on CPU (r4 7.9 vs r5 probes 6.1-6.9)
+    cmp_steps = max(12, steps // 2)
     while vs_baseline is None and try_bs >= n_chips:
         try:
             # the OOM-prone full-softmax model goes first so a failed
             # size doesn't waste a measured sampled run
             full_small = _run(lm1b.build_full_softmax_model(cfg), cfg,
-                              try_bs, T, max(5, steps // 3), warmup,
-                              "HYBRID")
+                              try_bs, T, cmp_steps, warmup, "HYBRID")
             sampled_small = _run(lm1b.build_model(cfg), cfg, try_bs, T,
-                                 max(5, steps // 3), warmup, "HYBRID")
+                                 cmp_steps, warmup, "HYBRID")
             vs_baseline = sampled_small / full_small
         except Exception as e:  # typically RESOURCE_EXHAUSTED
             print(f"# baseline at bs={try_bs} failed: "
