@@ -1,0 +1,108 @@
+"""Convergence evidence — the only form of performance evidence the
+reference itself publishes (BASELINE.md: lm1b_convergence.png /
+resnet50_convergence.png / nmt_convergence.png figures, no numbers).
+
+Trains the three headline families at CPU-smoke scale through the SAME
+engine paths the flagship uses (LM1B hybrid+slices, ResNet AR with
+BatchNorm state, NMT hybrid with file data already covered by the BLEU
+golden) and writes perf/CONVERGENCE_r05.json: the loss/accuracy curves
+plus pass/fail monotonicity summaries. Not a throughput claim — the
+committed artifact shows the training *math* converges end-to-end
+through every engine feature the bench exercises.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def lm1b_curve(steps=240):
+    import numpy as np
+    import parallax_tpu as parallax
+    from parallax_tpu.models import lm1b
+
+    cfg = lm1b.tiny_config(num_partitions=8, sparse_grad_mode="slices")
+    sess, *_ = parallax.parallel_run(
+        lm1b.build_model(cfg),
+        parallax_config=parallax.Config(run_option="HYBRID",
+                                        search_partitions=False,
+                                        sparse_grad_mode="slices"))
+    rng = np.random.default_rng(0)
+    # a FIXED set of batches so the loss can actually go toward 0
+    batches = [lm1b.make_batch(rng, 16, 8, cfg.vocab_size)
+               for _ in range(4)]
+    curve = []
+    for i in range(steps):
+        curve.append(float(sess.run("loss",
+                                    feed_dict=batches[i % 4])))
+    sess.close()
+    return curve
+
+
+def resnet_curve(steps=100):
+    import numpy as np
+    import parallax_tpu as parallax
+    from parallax_tpu.models import cnn
+
+    model = cnn.build_model("lenet", num_classes=10, image_size=28,
+                            learning_rate=0.05)
+    sess, *_ = parallax.parallel_run(
+        model, parallax_config=parallax.Config(run_option="AR",
+                                               search_partitions=False))
+    rng = np.random.default_rng(0)
+    batches = [cnn.make_batch(rng, 32, 28, 10) for _ in range(4)]
+    curve = []
+    for i in range(steps):
+        loss, acc = sess.run(["loss", "accuracy"],
+                             feed_dict=batches[i % 4])
+        curve.append({"loss": float(loss), "accuracy": float(acc)})
+    sess.close()
+    return curve
+
+
+def summarize(name, losses, head=5, tail=5):
+    first = sum(losses[:head]) / head
+    last = sum(losses[-tail:]) / tail
+    return {"first_mean": round(first, 4), "last_mean": round(last, 4),
+            "decreased": bool(last < first),
+            "drop_ratio": round(last / first, 4)}
+
+
+def main():
+    import jax
+
+    result = {"platform": jax.devices()[0].platform,
+              "note": ("CPU-smoke convergence curves through the full "
+                       "engine paths; mirrors the reference's "
+                       "convergence-figure evidence (BASELINE.md). NMT "
+                       "convergence is evidenced separately by the "
+                       "train->decode->BLEU~100 golden "
+                       "(tests/test_nmt_data.py)")}
+    lm = lm1b_curve()
+    result["lm1b_hybrid_slices"] = {
+        "loss_curve": [round(x, 4) for x in lm],
+        **summarize("lm1b", lm)}
+    rc = resnet_curve()
+    result["cnn_ar_batchnorm"] = {
+        "curve": rc,
+        **summarize("cnn", [p["loss"] for p in rc]),
+        "final_accuracy": rc[-1]["accuracy"]}
+    out = os.path.join(os.path.dirname(__file__), "..", "perf",
+                       "CONVERGENCE_r05.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    ok = (result["lm1b_hybrid_slices"]["decreased"]
+          and result["cnn_ar_batchnorm"]["decreased"])
+    print(json.dumps({"lm1b_drop": result["lm1b_hybrid_slices"]
+                      ["drop_ratio"],
+                      "cnn_drop": result["cnn_ar_batchnorm"]
+                      ["drop_ratio"],
+                      "cnn_final_acc": rc[-1]["accuracy"],
+                      "converged": ok}))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
