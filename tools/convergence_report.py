@@ -2,13 +2,15 @@
 reference itself publishes (BASELINE.md: lm1b_convergence.png /
 resnet50_convergence.png / nmt_convergence.png figures, no numbers).
 
-Trains the three headline families at CPU-smoke scale through the SAME
-engine paths the flagship uses (LM1B hybrid+slices, ResNet AR with
-BatchNorm state, NMT hybrid with file data already covered by the BLEU
-golden) and writes perf/CONVERGENCE_r05.json: the loss/accuracy curves
-plus pass/fail monotonicity summaries. Not a throughput claim — the
-committed artifact shows the training *math* converges end-to-end
-through every engine feature the bench exercises.
+Trains the headline families at CPU-smoke scale through the SAME
+engine paths the flagship uses (LM1B hybrid+slices; ResNet-50 on the
+AR path with its real BatchNorm mutable state; NMT file-data
+convergence is covered by the BLEU golden) and writes
+perf/CONVERGENCE_r05.json: the loss/accuracy curves plus an
+endpoint-drop + all-finite summary per curve (first-5 vs last-5 step
+means — NOT a step-wise monotonicity claim). Not a throughput claim —
+the committed artifact shows the training *math* converges end-to-end
+through the engine features the bench exercises.
 """
 
 import json
@@ -41,18 +43,21 @@ def lm1b_curve(steps=240):
     return curve
 
 
-def resnet_curve(steps=100):
+def resnet_curve(steps=40):
+    """ResNet-50 v1.5 at smoke shapes (32px) — a REAL BatchNorm model,
+    so the engine's mutable model_state path is actually exercised
+    (a LeNet stand-in here would silently skip it — r5 review)."""
     import numpy as np
     import parallax_tpu as parallax
     from parallax_tpu.models import cnn
 
-    model = cnn.build_model("lenet", num_classes=10, image_size=28,
-                            learning_rate=0.05)
+    model = cnn.build_model("resnet50_v1.5", num_classes=10,
+                            image_size=32, learning_rate=0.05)
     sess, *_ = parallax.parallel_run(
         model, parallax_config=parallax.Config(run_option="AR",
                                                search_partitions=False))
     rng = np.random.default_rng(0)
-    batches = [cnn.make_batch(rng, 32, 28, 10) for _ in range(4)]
+    batches = [cnn.make_batch(rng, 16, 32, 10) for _ in range(4)]
     curve = []
     for i in range(steps):
         loss, acc = sess.run(["loss", "accuracy"],
@@ -62,10 +67,12 @@ def resnet_curve(steps=100):
     return curve
 
 
-def summarize(name, losses, head=5, tail=5):
+def summarize(losses, head=5, tail=5):
+    import math
     first = sum(losses[:head]) / head
     last = sum(losses[-tail:]) / tail
     return {"first_mean": round(first, 4), "last_mean": round(last, 4),
+            "all_finite": bool(all(math.isfinite(x) for x in losses)),
             "decreased": bool(last < first),
             "drop_ratio": round(last / first, 4)}
 
@@ -83,18 +90,18 @@ def main():
     lm = lm1b_curve()
     result["lm1b_hybrid_slices"] = {
         "loss_curve": [round(x, 4) for x in lm],
-        **summarize("lm1b", lm)}
+        **summarize(lm)}
     rc = resnet_curve()
     result["cnn_ar_batchnorm"] = {
         "curve": rc,
-        **summarize("cnn", [p["loss"] for p in rc]),
+        **summarize([p["loss"] for p in rc]),
         "final_accuracy": rc[-1]["accuracy"]}
     out = os.path.join(os.path.dirname(__file__), "..", "perf",
                        "CONVERGENCE_r05.json")
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
-    ok = (result["lm1b_hybrid_slices"]["decreased"]
-          and result["cnn_ar_batchnorm"]["decreased"])
+    ok = all(result[k]["decreased"] and result[k]["all_finite"]
+             for k in ("lm1b_hybrid_slices", "cnn_ar_batchnorm"))
     print(json.dumps({"lm1b_drop": result["lm1b_hybrid_slices"]
                       ["drop_ratio"],
                       "cnn_drop": result["cnn_ar_batchnorm"]
