@@ -29,6 +29,18 @@ from jax.experimental import pallas as pl
 
 _NEG_INF = -1e30
 
+# Per-row scalars (lse, delta) cross the pallas_call boundary broadcast
+# over a trailing lane dimension: Mosaic requires the last two block
+# dims to be (8k, 128m) or EQUAL to the array dims, so a [B, H, T]
+# output with a per-(b, h) grid cannot be blocked legally — the r5 TPU
+# lowering check caught exactly this (interpret mode hid it). The
+# upstream kernel uses 128 lanes; 8 lanes satisfies the same rule via
+# the equal-dims clause (the whole lane dim is one block) at 1/16 the
+# HBM/VMEM cost of carrying a per-row scalar (r5 review). The public
+# surface stays [B, H, T] (lane 0 sliced off / broadcast back at the
+# boundary).
+_LANES = 8
+
 
 def _flash_fwd_kernel(*refs, kv_len: int, block_k: int, causal: bool,
                       scale: float, q_tile: int, has_mask: bool):
@@ -61,7 +73,7 @@ def _flash_fwd_kernel(*refs, kv_len: int, block_k: int, causal: bool,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # [q_tile, bk]
         if mask_ref is not None:
-            kv_ok = mask_ref[0, pl.dslice(kt * block_k, block_k)]
+            kv_ok = mask_ref[0, 0, pl.dslice(kt * block_k, block_k)]
             s = jnp.where(kv_ok[None, :] > 0, s, _NEG_INF)
         if causal:
             q_pos = qt * q_tile + jax.lax.broadcasted_iota(
@@ -81,7 +93,8 @@ def _flash_fwd_kernel(*refs, kv_len: int, block_k: int, causal: bool,
 
     m, l, acc = jax.lax.fori_loop(0, num_k, body, (m, l, acc))
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
+    lse_ref[0, 0] = jax.lax.broadcast_in_dim(
+        m + jnp.log(jnp.maximum(l, 1e-30)), (q_tile, _LANES), (0,))
 
 
 def _sds(shape, dtype, like):
@@ -121,23 +134,26 @@ def _flash_forward(q, k, v, kv_mask, causal: bool, scale: float,
     ]
     operands = [q, k, v]
     if has_mask:
-        in_specs.append(pl.BlockSpec((1, Tk), lambda b, h, i: (b, 0)))
-        operands.append(kv_mask)
-    return pl.pallas_call(
+        in_specs.append(pl.BlockSpec((1, 1, Tk),
+                                     lambda b, h, i: (b, 0, 0)))
+        operands.append(kv_mask[:, None, :])
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, q_tile, D),
                          lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, q_tile), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, q_tile, _LANES),
+                         lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
             _sds((B, H, Tq, D), q.dtype, q),
-            _sds((B, H, Tq), jnp.float32, q),
+            _sds((B, H, Tq, _LANES), jnp.float32, q),
         ],
         interpret=interpret,
     )(*operands)
+    return out, lse[..., 0]
 
 
 def _flash_dq_kernel(*refs, kv_len: int, block_k: int, causal: bool,
@@ -151,8 +167,8 @@ def _flash_dq_kernel(*refs, kv_len: int, block_k: int, causal: bool,
     qt = pl.program_id(2)
     q = q_ref[0, 0] * scale                                # [qt, D]
     do = do_ref[0, 0].astype(jnp.float32)                  # [qt, D]
-    lse = lse_ref[0, 0]                                    # [qt]
-    delta = delta_ref[0, 0]                                # [qt]
+    lse = lse_ref[0, 0][:, 0]                              # [qt] (lane 0)
+    delta = delta_ref[0, 0][:, 0]                          # [qt]
     D = q.shape[-1]
     dq = jnp.zeros((q_tile, D), jnp.float32)
     num_k = kv_len // block_k
@@ -167,7 +183,7 @@ def _flash_dq_kernel(*refs, kv_len: int, block_k: int, causal: bool,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [qt, bk]
         if mask_ref is not None:
-            kv_ok = mask_ref[0, pl.dslice(kt * block_k, block_k)]
+            kv_ok = mask_ref[0, 0, pl.dslice(kt * block_k, block_k)]
             s = jnp.where(kv_ok[None, :] > 0, s, _NEG_INF)
         if causal:
             q_pos = qt * q_tile + jax.lax.broadcasted_iota(
@@ -212,13 +228,13 @@ def _flash_dkv_kernel(*refs, q_len: int, q_blk: int, causal: bool,
         q = q_ref[0, 0, pl.dslice(qi * q_blk, q_blk), :] * scale
         do = do_ref[0, 0, pl.dslice(qi * q_blk, q_blk), :].astype(
             jnp.float32)
-        lse = lse_ref[0, 0, pl.dslice(qi * q_blk, q_blk)]
-        delta = delta_ref[0, 0, pl.dslice(qi * q_blk, q_blk)]
+        lse = lse_ref[0, 0, pl.dslice(qi * q_blk, q_blk), 0]
+        delta = delta_ref[0, 0, pl.dslice(qi * q_blk, q_blk), 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [qb, kt_]
         if mask_ref is not None:
-            kv_ok = mask_ref[0, :]
+            kv_ok = mask_ref[0, 0, :]
             s = jnp.where(kv_ok[None, :] > 0, s, _NEG_INF)
         if causal:
             q_pos = qi * q_blk + jax.lax.broadcasted_iota(
@@ -266,14 +282,18 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
     ]
     dq_operands = [q, k, v]
     if has_mask:
-        dq_specs.append(pl.BlockSpec((1, Tk), lambda b, h, i: (b, 0)))
-        dq_operands.append(kv_mask)
+        dq_specs.append(pl.BlockSpec((1, 1, Tk),
+                                     lambda b, h, i: (b, 0, 0)))
+        dq_operands.append(kv_mask[:, None, :])
+    # lse/delta travel lane-broadcast (see _LANES comment)
+    lse_b = jnp.broadcast_to(lse[..., None], (*lse.shape, _LANES))
+    delta_b = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
     dq_specs += [
         pl.BlockSpec((1, 1, q_tile, D), lambda b, h, i: (b, h, i, 0)),
-        pl.BlockSpec((1, 1, q_tile), lambda b, h, i: (b, h, i)),
-        pl.BlockSpec((1, 1, q_tile), lambda b, h, i: (b, h, i)),
+        pl.BlockSpec((1, 1, q_tile, _LANES), lambda b, h, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, q_tile, _LANES), lambda b, h, i: (b, h, i, 0)),
     ]
-    dq_operands += [g, lse, delta]
+    dq_operands += [g, lse_b, delta_b]
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, kv_len=Tk, block_k=block_k,
                           causal=causal, scale=scale, q_tile=q_tile,
@@ -293,15 +313,15 @@ def _flash_backward(q, k, v, kv_mask, out, lse, g, causal, scale,
     ]
     dkv_operands = [q, k, v]
     if has_mask:
-        dkv_specs.append(pl.BlockSpec((1, block_k),
-                                      lambda b, h, j: (b, j)))
-        dkv_operands.append(kv_mask)
+        dkv_specs.append(pl.BlockSpec((1, 1, block_k),
+                                      lambda b, h, j: (b, 0, j)))
+        dkv_operands.append(kv_mask[:, None, :])
     dkv_specs += [
         pl.BlockSpec((1, 1, Tq, D), lambda b, h, j: (b, h, 0, 0)),
-        pl.BlockSpec((1, 1, Tq), lambda b, h, j: (b, h, 0)),
-        pl.BlockSpec((1, 1, Tq), lambda b, h, j: (b, h, 0)),
+        pl.BlockSpec((1, 1, Tq, _LANES), lambda b, h, j: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, Tq, _LANES), lambda b, h, j: (b, h, 0, 0)),
     ]
-    dkv_operands += [g, lse, delta]
+    dkv_operands += [g, lse_b, delta_b]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, q_len=Tq, q_blk=q_tile,
                           causal=causal, scale=scale, k_tile=block_k,

@@ -1,0 +1,86 @@
+"""TPU lowering gates for the Pallas kernels (no TPU hardware needed).
+
+VERDICT r4 missing item 3: interpret-mode parity cannot prove the
+kernels lower for a real TensorCore — and it didn't: the first
+`jax.export(platforms=['tpu'])` of the flash forward failed Mosaic's
+(8, 128) block-tiling rule on the [B, H, T] lse output (fixed in r5 by
+the official lane-broadcast layout, see ops/pallas_attention._LANES).
+These tests run the full Pallas→Mosaic lowering pipeline on CPU via
+jax.export, so any block-shape/layout/unsupported-op regression fails
+in CI instead of on first hardware contact. (Mosaic→TensorCore codegen
+itself still needs a chip; perf/probe_r05/watch_relay.sh runs the
+parity suite there the moment the relay exists.)
+"""
+
+import jax
+import jax.numpy as jnp
+
+from parallax_tpu.ops import pallas_lstm
+from parallax_tpu.ops.pallas_attention import (flash_attention,
+                                               flash_attention_lse)
+
+
+def _export_tpu(fn, *args):
+    exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    text = exp.mlir_module()
+    assert "tpu_custom_call" in text, "no Mosaic kernel in the module"
+    return text
+
+
+B, T, H, D = 2, 2048, 8, 64
+_S = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16)
+
+
+def test_flash_attention_fwd_lowers_for_tpu():
+    _export_tpu(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=False), _S, _S, _S)
+
+
+def test_flash_attention_bwd_lowers_for_tpu():
+    def fwd_bwd(q, k, v):
+        return jax.grad(lambda *a: jnp.sum(flash_attention(
+            *a, causal=True, interpret=False).astype(jnp.float32)),
+            argnums=(0, 1, 2))(q, k, v)
+    text = _export_tpu(fwd_bwd, _S, _S, _S)
+    # fwd + dq + dkv kernels all present
+    assert text.count("tpu_custom_call") == 3, text.count(
+        "tpu_custom_call")
+
+
+def test_flash_attention_lse_bwd_lowers_for_tpu():
+    """The ring-attention block surface: (out, lse) forward and the
+    delta-shifted backward (lse cotangent) must lower too."""
+    def fwd_bwd(q, k, v):
+        def loss(*a):
+            out, lse = flash_attention_lse(*a, causal=True,
+                                           interpret=False)
+            return jnp.sum(out.astype(jnp.float32)) + jnp.sum(lse)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    _export_tpu(fwd_bwd, _S, _S, _S)
+
+
+def test_flash_attention_masked_bwd_lowers_for_tpu():
+    """The kv_mask (padding) path NMT/BERT use — its [B, Tk] block
+    spec violated the same tiling rule as lse before r5 reshaped it to
+    [B, 1, Tk] (r5 review finding)."""
+    mask = jax.ShapeDtypeStruct((B, T), jnp.int32)
+
+    def fwd_bwd(q, k, v, m):
+        return jax.grad(lambda *a: jnp.sum(flash_attention(
+            *a, kv_mask=m, interpret=False).astype(jnp.float32)),
+            argnums=(0, 1, 2))(q, k, v)
+    text = _export_tpu(fwd_bwd, _S, _S, _S, mask)
+    assert text.count("tpu_custom_call") == 3
+
+
+def test_pallas_lstm_flagship_lowers_for_tpu():
+    """The flagship recurrence at its real weight shape (bf16
+    [1024, 8192]) through the r5 hoisted/resident kernel."""
+    T_, B_ = 4, 128
+    E, H_, P = 512, 2048, 512
+    args = (jax.ShapeDtypeStruct((T_, B_, E), jnp.bfloat16),
+            jax.ShapeDtypeStruct((E + P, 4 * H_), jnp.bfloat16),
+            jax.ShapeDtypeStruct((4 * H_,), jnp.bfloat16),
+            jax.ShapeDtypeStruct((H_, P), jnp.bfloat16))
+    _export_tpu(lambda x, w, b, wp: pallas_lstm.lstm_scan(
+        x, w, b, wp, impl="pallas", interpret=False), *args)
