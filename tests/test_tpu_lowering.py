@@ -113,3 +113,25 @@ def test_hybrid_engine_step_lowers_for_tpu():
     n_coll = (text.count("all_gather") + text.count("all_reduce")
               + text.count("reduce_scatter") + text.count("all_to_all"))
     assert n_coll > 0, "no collectives in the sharded step module"
+
+
+def test_tp_sp_engine_step_lowers_for_tpu():
+    """And the TP x SP composition (Megatron kernels, seq-sharded
+    resting activations, vocab-parallel head) on the same mesh."""
+    import numpy as np
+    from parallax_tpu.common.config import ParallaxConfig
+    from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
+    from parallax_tpu.models import long_context as lc
+
+    mesh = mesh_lib.build_mesh(jax.devices()[:8], num_partitions=4)
+    config = ParallaxConfig(run_option="HYBRID", search_partitions=False)
+    cfg = lc.tiny_config(max_len=16, num_heads=4)
+    cfg.parallelism = "tensor"
+    cfg.tp_sequence_parallel = True
+    batch = lc.make_batch(np.random.default_rng(3), batch_size=16,
+                          seq_len=16, vocab_size=cfg.vocab_size)
+    eng = engine_lib.Engine(lc.build_model(cfg), mesh, config, batch)
+    state = eng.init_state(0)
+    exp = jax.export.export(eng._step_jit, platforms=["tpu"])(
+        state, eng.shard_batch(batch))
+    assert len(exp.mlir_module()) > 0
