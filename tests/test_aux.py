@@ -318,3 +318,32 @@ class TestMoreTriggers:
                  glob.glob(os.path.join(ckpt_dir, "*"))
                  if os.path.basename(p).isdigit()]
         assert steps, "secs trigger never saved"
+
+
+class TestPrepare:
+    def test_prepare_builds_without_stepping_and_reports_restore(
+            self, tmp_path, rng):
+        """Session.prepare(): engine + checkpoint restore without a
+        training step — fresh session reports 0, restored session the
+        checkpointed step, and state/mesh are readable before step 1
+        (the elastic-resume seeding contract, r5)."""
+        ckpt_dir = str(tmp_path / "ckpt_prep")
+        cfg = parallax.Config(
+            run_option="AR", search_partitions=False,
+            ckpt_config=parallax.CheckPointConfig(ckpt_dir=ckpt_dir,
+                                                  save_ckpt_steps=3))
+        batch = simple.make_batch(rng, 32)
+        sess, *_ = parallax.parallel_run(simple.build_model(0.1),
+                                         parallax_config=cfg)
+        assert sess.prepare(batch) == 0          # fresh run
+        assert int(sess.state.step) == 0         # no step ran
+        assert sess.engine is not None
+        _run_steps(sess, rng, 6)                 # ckpts at 3 and 6
+        sess.close()
+
+        sess2, *_ = parallax.parallel_run(simple.build_model(0.1),
+                                          parallax_config=cfg)
+        assert sess2.prepare(batch) == 6         # restored, still no step
+        _, step = _run_steps(sess2, rng, 1)
+        assert step == 7
+        sess2.close()
