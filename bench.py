@@ -62,18 +62,52 @@ def _log_probe(attempt: int, status: str, stdout: str, stderr: str):
         pass
 
 
-def _relay_listening(port: int = 8083, timeout: float = 2.0) -> bool:
+def _relay_addr() -> tuple:
+    """(host, port) the axon client will actually dial. The client reads
+    AXON_POOL_SVC_OVERRIDE (perf/probe_r05/h4_* probes: set ⇒ dialed,
+    unset ⇒ pool-mode error), so a hardcoded 127.0.0.1:8083 here would
+    misreport an overridden relay as down and skip a claim probe that
+    could have succeeded (ADVICE r5). Accepts 'host', 'host:port' and
+    '[v6addr]:port' forms; falls back to the loopback default."""
+    override = os.environ.get("AXON_POOL_SVC_OVERRIDE", "").strip()
+    host, port = "127.0.0.1", 8083
+    if override:
+        # tolerate URL-ish values: strip scheme and any path suffix so
+        # an unparsed remainder can never leak ':' into the host (which
+        # would flip _relay_listening to AF_INET6 on a non-v6 name)
+        if "://" in override:
+            override = override.split("://", 1)[1]
+        override = override.split("/", 1)[0]
+        host = override
+        if override.startswith("["):           # [v6addr] or [v6addr]:port
+            addr, _, rest = override[1:].partition("]")
+            host = addr or host
+            if rest.startswith(":") and rest[1:].isdigit():
+                port = int(rest[1:])
+        elif override.count(":") == 1:         # host:port (not bare v6)
+            h, p = override.split(":")
+            # empty host (":8084") falls back to loopback, never to the
+            # unsplit override (which would leak ':' into the host)
+            if p.isdigit():
+                host, port = h or "127.0.0.1", int(p)
+            else:
+                host = h or "127.0.0.1"        # non-numeric port: drop it
+    return host, port
+
+
+def _relay_listening(timeout: float = 2.0) -> bool:
     """1-second claim-free readiness check. perf/probe_r05/POSTMORTEM.md:
-    the axon client's device init is an HTTP GET against the loopback
-    relay's stateless port (8083); when nothing listens there the init
-    loop retries a synchronously-refused connect forever, so a refused
-    TCP connect here means a jax.devices() probe can only burn its full
-    timeout. No JAX, no claim state — safe to call any time."""
+    the axon client's device init is an HTTP GET against the relay's
+    stateless port; when nothing listens there the init loop retries a
+    synchronously-refused connect forever, so a refused TCP connect here
+    means a jax.devices() probe can only burn its full timeout. No JAX,
+    no claim state — safe to call any time."""
     import socket
-    s = socket.socket()
+    host, port = _relay_addr()
+    s = socket.socket(socket.AF_INET6 if ":" in host else socket.AF_INET)
     s.settimeout(timeout)
     try:
-        return s.connect_ex(("127.0.0.1", port)) == 0
+        return s.connect_ex((host, port)) == 0
     except OSError:
         return False
     finally:
@@ -136,9 +170,10 @@ def main():
         if not _relay_listening():
             # r5 post-mortem: refused relay port == the probe can only
             # hang to its timeout; don't burn 15 min discovering that
-            _log_probe(attempt, "RELAY DOWN (127.0.0.1:8083 refused; "
+            relay = "%s:%d" % _relay_addr()
+            _log_probe(attempt, f"RELAY DOWN ({relay} refused; "
                        "skipping jax.devices probe)", "", "")
-            print("# axon relay not listening on 127.0.0.1:8083; "
+            print(f"# axon relay not listening on {relay}; "
                   "skipping claim probe", flush=True)
         else:
             # long FIRST timeout: a cold relay handshake through the
@@ -193,7 +228,7 @@ def main():
 
 
 def _run(model, cfg, batch_size, num_steps, steps, warmup, run_option,
-         wire_stats=None):
+         wire_stats=None, pipeline_stats=None):
     import jax
     import numpy as np
     import parallax_tpu as parallax
@@ -213,21 +248,32 @@ def _run(model, cfg, batch_size, num_steps, steps, warmup, run_option,
             wire_stats.update(
                 sess.engine.sparse_wire_bytes_per_step())
         jax.block_until_ready(sess.state.params)
-        # Steady-state loop: steps dispatch back-to-back (fetch nothing
-        # per step — a scalar fetch is a host<->device round trip that
-        # serializes dispatch); block once at the end. One long window:
-        # splitting into best-of-k windows was tried (r5) and REJECTED —
-        # the per-window pipeline drain cost more than host-interference
-        # noise on every backend. The words count equals the feed's
-        # weight sum — the same value the "words" metric computes on
-        # device.
+        # Steady-state loop through the async pipeline: run_iter preps +
+        # places batch t+1 on a background thread while step t runs. The
+        # per-step "loss" fetch is LAZY (a Fetch handle — no host<->
+        # device round trip, so dispatch never serializes; the old loop
+        # had to fetch [] to get the same property); only the last one
+        # is materialized, which records the real pipeline-drain time as
+        # blocked_on_device. One long window: splitting into best-of-k
+        # windows was tried (r5) and REJECTED — the per-window pipeline
+        # drain cost more than host-interference noise on every backend.
+        # The words count equals the feed's weight sum — the same value
+        # the "words" metric computes on device.
+        words_per_batch = [float(b["w"].sum()) for b in batches]
         t0 = time.perf_counter()
         words = 0.0
-        for i in range(steps):
-            sess.run([], feed_dict=batches[i % 4])
-            words += float(batches[i % 4]["w"].sum())
+        last = None
+        feed = (batches[i % 4] for i in range(steps))
+        for i, last in enumerate(sess.run_iter(feed, fetches="loss")):
+            words += words_per_batch[i % 4]
+        float(last)  # drain: blocks until the final step retires
         jax.block_until_ready(sess.state.params)
         dt = time.perf_counter() - t0
+        if pipeline_stats is not None:
+            # dispatch-gap / H2D-bytes / blocked-on-device over the
+            # measured window (the overlap observability this bench
+            # guards; regressions show up as a growing dispatch gap)
+            pipeline_stats.update(sess.pipeline_stats.summary())
         return words / dt
     finally:
         # free HBM even on OOM so the retry loop's smaller attempt
@@ -276,8 +322,9 @@ def worker_main():
 
     # Headline: hybrid engine at the realistic batch size.
     wire = {}
+    pipe = {}
     hybrid_wps = _run(lm1b.build_model(cfg), cfg, bs, T, steps, warmup,
-                      "HYBRID", wire_stats=wire)
+                      "HYBRID", wire_stats=wire, pipeline_stats=pipe)
     # Baseline comparison at a common batch size both paths can run. The
     # full-softmax baseline materializes [B*T, V] logits; retry smaller
     # if it doesn't fit rather than losing the whole headline.
@@ -330,6 +377,8 @@ def worker_main():
         "flops_per_step": fpw * bs * T,
         "device_peak_flops": peak,
         "mfu": round(mfu, 4) if mfu is not None else None,
+        # async-pipeline health over the headline window (PipelineStats)
+        "pipeline": pipe or None,
     }
     if wire.get("dense_allreduce_bytes"):
         # north-star secondary metric: sparse-grad bytes on wire per step

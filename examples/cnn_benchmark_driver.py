@@ -52,11 +52,16 @@ def main():
         loss, acc, step = sess.run(["loss", "accuracy", "global_step"],
                                    feed_dict=batches[i % 4])
         steps_done += 1
-        if step % args.log_frequency == 0:
+        # host-side log gate: reading the lazy `step` fetch every
+        # iteration would block dispatch on step t retiring
+        if (i + 1) % args.log_frequency == 0:
+            # materialize BEFORE reading the clock: the window must
+            # cover execution, not just dispatch, of its steps
+            loss_v, acc_v = float(loss), float(acc)
             now = time.perf_counter()
             sps = steps_done / (now - t_last)
             t_last, steps_done = now, 0
-            print(f"step {step}: loss {loss:.4f} acc {acc:.3f}  "
+            print(f"step {step}: loss {loss_v:.4f} acc {acc_v:.3f}  "
                   f"{sps:.2f} steps/sec ({sps * args.batch_size:,.0f} "
                   f"images/sec)")
     sess.close()

@@ -84,18 +84,27 @@ def main():
               f"({dataset.backend} backend)")
 
     rng = np.random.default_rng(worker_id)
-    words_acc, t_last = 0.0, time.perf_counter()
-    for i in range(args.max_steps):
-        batch = (dataset.next_batch() if dataset
-                 else lm1b.make_batch(rng, args.batch_size,
-                                      args.num_steps, cfg.vocab_size))
-        loss, words, step = sess.run(["loss", "words", "global_step"],
-                                     feed_dict=batch)
-        words_acc += words
-        if step % args.log_frequency == 0:
+
+    def feed():
+        for _ in range(args.max_steps):
+            yield (dataset.next_batch() if dataset
+                   else lm1b.make_batch(rng, args.batch_size,
+                                        args.num_steps, cfg.vocab_size))
+
+    pending_words, t_last = [], time.perf_counter()
+    # pipelined loop: batch t+1 is assembled (native loader) + placed on
+    # device by the session's prefetch thread while step t runs. The log
+    # gate uses a host-side counter and fetches stay LAZY until the log
+    # step — materializing any per step would block dispatch on step t
+    # retiring and give the pipelining right back.
+    for i, (loss, words, step) in enumerate(sess.run_iter(
+            feed(), ["loss", "words", "global_step"])):
+        pending_words.append(words)
+        if (i + 1) % args.log_frequency == 0:
+            words_acc = sum(float(w) for w in pending_words)
             now = time.perf_counter()
             wps = words_acc / (now - t_last)
-            words_acc, t_last = 0.0, now
+            pending_words, t_last = [], now
             print(f"step {step}: loss {loss:.4f}  {wps:,.0f} words/sec")
     sess.close()
 

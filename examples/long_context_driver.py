@@ -81,18 +81,21 @@ def main():
         num_partitions=args.partitions)
 
     rng = np.random.default_rng(worker_id)
-    tokens, t_last = 0.0, time.perf_counter()
+    pending, t_last = [], time.perf_counter()
     for i in range(args.max_steps):
         batch = lc.make_batch(rng, args.batch_size, args.seq_len,
                               cfg.vocab_size)
         loss, tk, step = sess.run(["loss", "tokens", "global_step"],
                                   feed_dict=batch)
-        tokens += tk
-        if step % args.log_frequency == 0:
+        # host-side log gate + deferred reads: materializing any fetch
+        # every iteration would block dispatch on step t retiring
+        pending.append(tk)
+        if (i + 1) % args.log_frequency == 0:
+            tokens = sum(float(x) for x in pending)
             now = time.perf_counter()
             print(f"step {step}: loss {loss:.4f}  "
                   f"{tokens / (now - t_last):,.0f} tokens/sec")
-            tokens, t_last = 0.0, now
+            pending, t_last = [], now
     sess.close()
 
 

@@ -92,7 +92,7 @@ def main():
         batches = batches()
 
     rng = np.random.default_rng(worker_id)
-    words, t_last = 0.0, time.perf_counter()
+    pending, t_last = [], time.perf_counter()
     # --batch_size is the GLOBAL batch in both modes: the file iterator
     # row-stripes it across workers, and the synthetic path feeds
     # batch_size/num_workers rows per worker to match
@@ -107,12 +107,15 @@ def main():
                                      args.tgt_len, cfg.vocab_size))
         loss, w, step = sess.run(["loss", "words", "global_step"],
                                  feed_dict=batch)
-        words += w
-        if step % args.log_frequency == 0:
+        # host-side log gate + deferred reads: materializing any fetch
+        # every iteration would block dispatch on step t retiring
+        pending.append(w)
+        if (i + 1) % args.log_frequency == 0:
+            words = sum(float(x) for x in pending)
             now = time.perf_counter()
             print(f"step {step}: loss {loss:.4f}  "
                   f"{words / (now - t_last):,.0f} target words/sec")
-            words, t_last = 0.0, now
+            pending, t_last = [], now
     sess.close()
 
 

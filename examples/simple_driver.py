@@ -42,7 +42,9 @@ def main():
         batch = simple.make_batch(rng, args.batch_size)
         loss, step = sess.run(["loss", "global_step"],
                               feed_dict={"x": batch["x"], "y": batch["y"]})
-        if step % 10 == 0 or step == 1:
+        # host-side log gate: reading the lazy `step` fetch every
+        # iteration would block dispatch on step t retiring
+        if (i + 1) % 10 == 0 or i == 0:
             print(f"step {step}: loss {loss:.6f}")
     out = sess.run(None, feed_dict=batch)
     print(f"learned w={out['w']:.3f} (true 10.0)  "

@@ -46,11 +46,16 @@ def main():
         batch = skip_thoughts.make_batch(rng, args.batch_size,
                                          args.seq_len, cfg.vocab_size)
         loss, step = sess.run(["loss", "global_step"], feed_dict=batch)
-        if step % args.log_frequency == 0:
+        # host-side log gate: reading the lazy `step` fetch every
+        # iteration would block dispatch on step t retiring
+        if (i + 1) % args.log_frequency == 0:
+            # materialize BEFORE reading the clock: the window must
+            # cover execution, not just dispatch, of its steps
+            loss_v = float(loss)
             now = time.perf_counter()
             sps = args.log_frequency / (now - t_last)
             t_last = now
-            print(f"step {step}: loss {loss:.4f}  {sps:.2f} steps/sec")
+            print(f"step {step}: loss {loss_v:.4f}  {sps:.2f} steps/sec")
     sess.close()
 
 

@@ -22,7 +22,8 @@ from parallax_tpu.common.lib import parallax_log as log
 from parallax_tpu.core.engine import Model, TrainState
 from parallax_tpu.parallel.partitions import get_partitioner
 from parallax_tpu.runner import parallel_run
-from parallax_tpu.session import ParallaxSession
+from parallax_tpu.session import (Fetch, ParallaxSession, StepHandle,
+                                  materialize)
 from parallax_tpu import ops, shard
 
 __version__ = "0.1.0"
@@ -31,5 +32,5 @@ __all__ = [
     "get_partitioner", "parallel_run", "shard", "log", "Config",
     "ParallaxConfig", "PSConfig", "MPIConfig", "CommunicationConfig",
     "CheckPointConfig", "ProfileConfig", "Model", "TrainState",
-    "ParallaxSession", "ops",
+    "ParallaxSession", "Fetch", "StepHandle", "materialize", "ops",
 ]

@@ -1,0 +1,70 @@
+"""Version-compat shims for the jax API surface the engine relies on.
+
+The ops layer targets the modern `jax.shard_map` entry point; on older
+jax releases the same functionality lives at
+`jax.experimental.shard_map.shard_map` with ``check_rep`` in place of
+``check_vma``. Every in-repo shard_map call routes through here so a
+single site owns the translation.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    # On jax versions where `jax.export` is a lazily-imported submodule
+    # rather than an eager attribute, importing it here makes plain
+    # `jax.export.export(...)` call sites (tests/test_tpu_lowering.py)
+    # work process-wide once any parallax_tpu module loads.
+    import jax.export  # noqa: F401
+except ImportError:  # truly absent: those call sites fail as before
+    pass
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """`jax.shard_map` with the modern signature on every supported jax.
+
+    ``check_vma`` follows the current API; it maps onto the legacy
+    ``check_rep`` flag (same meaning: disable the replication/varying
+    checker, e.g. around pallas interpret-mode calls it cannot see
+    through).
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _legacy
+    return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
+
+
+# Whether this jax has the VMA (varying-manual-axes) type system —
+# spelled `jax.lax.pcast` on the newest releases, `jax.lax.pvary` on the
+# intermediate ones. When neither exists, `pcast` below is a no-op and
+# callers whose collectives the legacy replication checker cannot infer
+# (e.g. ring attention's scan carry) must run with the checker off —
+# there is no way to inform it.
+HAS_VMA = hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
+
+
+def pcast(x, axes, *, to="varying"):
+    """`jax.lax.pcast` where it exists, `jax.lax.pvary` (its former
+    name for the to='varying' direction) where only that exists. On
+    legacy jax there is no VMA type system to inform — the replication
+    checker (``check_rep``) does its own inference — so the marking is
+    correctly a no-op."""
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is not None:
+        return fn(x, axes, to=to)
+    fn = getattr(jax.lax, "pvary", None)
+    if fn is not None and to == "varying":
+        return fn(x, axes)
+    return x
+
+
+def typeof(x):
+    """`jax.typeof` (the aval, carrying `.vma` on modern jax); legacy
+    fallback returns the plain aval, whose missing `.vma` downstream
+    code must treat as 'no varying axes'."""
+    if hasattr(jax, "typeof"):
+        return jax.typeof(x)
+    return jax.core.get_aval(x)

@@ -173,10 +173,28 @@ class ParallaxConfig:
       clip, straight into the sparse optimizer kernel; reference
       examples/lm1b/language_model_graph.py:48-58). No [V, D] cotangent,
       accumulator pass, or table-grad norm is ever materialized.
+    * ``prefetch_depth`` / ``eager_fetch``: async step pipeline knobs
+      (no reference analogue — the reference's tf.data input pipeline
+      owned this); see the field comments and session.py.
     """
 
     run_option: str = consts.RUN_HYBRID
     sparse_grad_mode: str = "dense"
+    # -- async step pipeline (session.py) --------------------------------
+    # Bounded depth of the background feed prefetcher behind
+    # ``session.run_iter`` / ``data.prefetch_to_device``: how many
+    # converted-and-placed batches may exist ahead of the step consuming
+    # them. 2 keeps one batch in flight on the H2D path while one waits,
+    # bounding host+HBM staging memory; raise it only when feed prep has
+    # high variance.
+    prefetch_depth: int = 2
+    # When True, ``run()`` materializes every fetch to a host value
+    # before returning (the pre-async blocking behavior). Default False:
+    # fetches come back as lazy ``Fetch`` handles and the host thread is
+    # free to prepare batch t+1 while step t runs. Profiling steps and
+    # the partition search always block regardless, so their wall-times
+    # cover real device work.
+    eager_fetch: bool = False
     # sync=False only: gradient staleness bound k — each step applies
     # the gradients computed k steps earlier (deterministic SPMD
     # emulation of the reference's async PS, whose staleness was
@@ -209,6 +227,9 @@ class ParallaxConfig:
         if int(self.staleness) < 1:
             raise ValueError(
                 f"staleness must be >= 1, got {self.staleness}")
+        if int(self.prefetch_depth) < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {self.prefetch_depth}")
 
     # Reference-style setters (kept so ported driver code works unchanged).
     def set_sync(self, sync: bool) -> None:

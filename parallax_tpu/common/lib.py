@@ -167,14 +167,21 @@ def is_local_host(hostname: str) -> bool:
     resource file listing the master's real hostname must not make the
     master ssh to itself or take the remote pid-file kill path for a
     local child (the reference had exactly that wart)."""
-    if hostname in ("localhost", "::1"):
+    if hostname == "localhost":
         return True
     # ALL of 127/8 is the loopback network on Linux — resource files can
     # name 127.0.0.2/127.0.0.3/... to run several local workers (the
     # duplicate-host check in parse_resource_info requires distinct
-    # names; the N-process CPU rigs in tests/multihost_*.py use this)
-    if hostname.startswith("127."):
-        return True
+    # names; the N-process CPU rigs in tests/multihost_*.py use this).
+    # Only a literal loopback ADDRESS takes the shortcut: a hostname
+    # that merely looks like one (e.g. "127.example.com") must go
+    # through the resolver path below like any other name.
+    import ipaddress
+    try:
+        if ipaddress.ip_address(hostname).is_loopback:
+            return True
+    except ValueError:
+        pass  # not an IP literal; fall through to the resolver
     import socket
     try:
         own = {socket.gethostname(), socket.getfqdn()}

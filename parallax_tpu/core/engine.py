@@ -621,8 +621,14 @@ class Engine:
         with self.mesh:
             return self._init_jit(seed)
 
-    def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
-        batch = self.shard_batch(batch)
+    def step(self, state: TrainState, batch,
+             preplaced: bool = False) -> Tuple[TrainState, Dict]:
+        """One training step. ``preplaced=True`` means ``batch`` already
+        went through ``shard_batch`` (the async pipeline places batches
+        on a background thread; re-placing would block the dispatch
+        thread on a host round trip and re-run feed_transforms)."""
+        if not preplaced:
+            batch = self.shard_batch(batch)
         with self.mesh:
             new_state, outputs = self._step_jit(state, batch)
         if not self._exported_graph and self.config.export_graph_path:

@@ -1,3 +1,6 @@
-from parallax_tpu.data.loader import TokenDataset, write_token_file
+from parallax_tpu.data.loader import (TokenDataset, prefetch_to_device,
+                                      write_token_file)
+from parallax_tpu.data.prefetch import Prefetcher
 
-__all__ = ["TokenDataset", "write_token_file"]
+__all__ = ["TokenDataset", "write_token_file", "prefetch_to_device",
+           "Prefetcher"]

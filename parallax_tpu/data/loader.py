@@ -17,11 +17,12 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-from typing import Iterator, Optional
+from typing import Callable, Iterable, Iterator, Optional
 
 import numpy as np
 
 from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.data.prefetch import Prefetcher
 
 _SO_NAME = "libparallax_data.so"
 _lib = None
@@ -181,3 +182,24 @@ class TokenDataset:
             self.close()
         except Exception:
             pass
+
+
+def prefetch_to_device(batches: Iterable, place_fn: Callable,
+                       depth: int = 2) -> Prefetcher:
+    """Chain a host-batch iterator straight into device placement on a
+    background thread.
+
+    ``batches`` is any iterable of feed dicts — typically a
+    ``TokenDataset``, whose native backend already assembles windows on
+    its own C++ thread; this adapter adds the second pipeline stage so
+    feed conversion + H2D transfer for batch *t+1* overlap step *t*'s
+    device compute. ``place_fn`` maps one host batch to its placed form
+    — pass ``session.place_batch`` (feed conversion + ``shard_batch``,
+    incl. ``feed_transforms`` and multi-host
+    ``make_array_from_process_local_data``) and feed the yielded batches
+    to ``session.run_iter(..., placed=True)`` or
+    ``engine.step(state, b, preplaced=True)``. At most ``depth`` placed
+    batches are held at once. Returns a ``Prefetcher`` (an iterator;
+    also a context manager — ``close()`` stops the thread)."""
+    return Prefetcher(batches, place_fn, depth=depth,
+                      name="parallax-h2d-prefetch")

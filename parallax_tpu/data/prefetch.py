@@ -1,0 +1,146 @@
+"""Bounded background prefetch: overlap feed prep with device compute.
+
+The session's synchronous loop re-introduces the host-side bubble the
+paper's AllReduce/PS overlap removes on the device side: between steps
+the TPU idles while the host converts + places the next batch, and the
+host idles while the device computes. ``Prefetcher`` is the shared
+remedy — a daemon thread pulls items from an iterator, runs an arbitrary
+``place_fn`` (feed conversion, ``feed_transforms``, ``device_put`` /
+``make_array_from_process_local_data``) and parks the results in a
+bounded queue, so batch *t+1* is already on device when step *t*
+retires. Used by ``ParallaxSession.run_iter`` and by the
+``prefetch_to_device`` adapter chained onto the native C++ token
+loader's own background thread (data/loader.py).
+
+Semantics:
+  * strict FIFO — results come out in iterator order, always;
+  * bounded depth (default 2) — at most ``depth`` prepared batches
+    exist at once, so host memory / HBM staging stays O(depth);
+  * exceptions raised by the iterator OR ``place_fn`` propagate to the
+    consumer at the point the failed item would have been yielded;
+  * ``close()`` (also via context manager / generator finalization)
+    stops the thread promptly even when the queue is full.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+
+class _End:
+    """Queue sentinel: normal exhaustion of the source iterator."""
+
+
+class _Raised:
+    """Queue sentinel carrying an exception from the worker thread."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class Prefetcher:
+    """Iterate ``place_fn(item)`` for each item of ``source``, computed
+    ``depth`` items ahead on a background thread."""
+
+    def __init__(self, source: Iterable, place_fn: Optional[Callable] = None,
+                 depth: int = 2, name: str = "parallax-prefetch"):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._source = iter(source)
+        self._place_fn = place_fn
+        # depth slots of *finished* work; the item the worker is busy
+        # placing makes the effective pipeline depth+1 deep, matching
+        # the usual "prefetch(n)" contract
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._thread = threading.Thread(target=self._worker, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- worker ------------------------------------------------------------
+
+    def _worker(self):
+        try:
+            for item in self._source:
+                if self._stop.is_set():
+                    return
+                if self._place_fn is not None:
+                    item = self._place_fn(item)
+                self._put(item)
+                if self._stop.is_set():
+                    return
+            self._put(_End)
+        except BaseException as e:  # propagate to the consumer
+            self._put(_Raised(e))
+
+    def _put(self, item):
+        """queue.put that aborts promptly on close() instead of blocking
+        forever on a full queue nobody will drain."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    # -- consumer ----------------------------------------------------------
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        while True:
+            try:
+                # bounded wait so a cross-thread close() (e.g.
+                # session.close() from a shutdown handler) can never
+                # strand a consumer blocked on an empty queue the
+                # stopped worker will no longer fill
+                got = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if self._stop.is_set():
+                    self._done = True
+                    raise StopIteration from None
+        if got is _End:
+            self._done = True
+            raise StopIteration
+        if isinstance(got, _Raised):
+            self._done = True
+            raise got.exc
+        return got
+
+    @property
+    def alive(self) -> bool:
+        """True while the background thread is running."""
+        return self._thread.is_alive()
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the worker and release queue slots. Idempotent; safe to
+        call with items still queued (they are dropped)."""
+        self._done = True
+        self._stop.set()
+        # drain so a _put blocked on a full queue observes the stop flag
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout)
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close(timeout=0.0)
+        except Exception:
+            pass

@@ -53,6 +53,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from parallax_tpu.common import compat
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -66,12 +67,18 @@ def _split_w(w, w_proj):
 
 def _hoisted_xw(x_seq, w_x, b):
     """The input-projection half of the gate pre-activation for ALL
-    timesteps as one batched matmul: [T, B, E] -> fp32 [T, B, 4H].
-    fp32 result so the per-step add inside the recurrence loses nothing
-    vs the fused single-dot formulation beyond dot-splitting order."""
-    return jax.lax.dot_general(
+    timesteps as one batched matmul: [T, B, E] -> [T, B, 4H] in the
+    COMPUTE dtype (x_seq's). The matmul itself accumulates in fp32; the
+    result is stored at the input precision because this buffer is the
+    dominant HBM traffic of the whole op (written once, re-read every
+    timestep) — keeping it fp32 doubled it and erased half the
+    documented ~3.3x HBM win (ADVICE r5). Inside the recurrence it is
+    widened back to fp32 before the add, so the only precision cost is
+    the one storage rounding of xw."""
+    xw = jax.lax.dot_general(
         x_seq.astype(w_x.dtype), w_x, (((2,), (0,)), ((), ())),
         preferred_element_type=jnp.float32) + b.astype(jnp.float32)
+    return xw.astype(x_seq.dtype)
 
 
 def lstm_scan_reference(x_seq, w, b, w_proj):
@@ -82,15 +89,15 @@ def lstm_scan_reference(x_seq, w, b, w_proj):
     match the Pallas forward bit-for-bit in semantics — it deliberately
     differs from models/lm1b.lstm_scan's plain compute-dtype scan (bf16
     carries there; the kernel's fp32 carry is strictly more precise)."""
-    T, B, E = x_seq.shape
+    T, B, _ = x_seq.shape
     H = w.shape[1] // 4
     P = w_proj.shape[1]
     w_x, w_h = _split_w(w, w_proj)
-    xw = _hoisted_xw(x_seq, w_x, b)                    # [T, B, 4H] fp32
+    xw = _hoisted_xw(x_seq, w_x, b)              # [T, B, 4H] x dtype
 
     def cell(carry, xw_t):
         c, h = carry                                   # fp32
-        gates = xw_t + jax.lax.dot_general(
+        gates = xw_t.astype(jnp.float32) + jax.lax.dot_general(
             h.astype(w_h.dtype), w_h, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         i, f, g, o = jnp.split(gates, 4, axis=-1)
@@ -123,7 +130,7 @@ def _lstm_kernel(xw_ref, wh_ref, wp_ref, out_ref, c_ref, h_ref):
     w_h = wh_ref[...]                                 # [P, 4H] resident
     wp = wp_ref[...]                                  # [H, P]  resident
     c, h = c_ref[...], h_ref[...]                     # fp32
-    gates = xw_ref[0] + jax.lax.dot_general(
+    gates = xw_ref[0].astype(jnp.float32) + jax.lax.dot_general(
         h.astype(w_h.dtype), w_h, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     i, f, g, o = jnp.split(gates, 4, axis=-1)
@@ -137,11 +144,11 @@ def _lstm_kernel(xw_ref, wh_ref, wp_ref, out_ref, c_ref, h_ref):
 
 
 def _forward(x_seq, w, b, w_proj, batch_tile: int, interpret: bool):
-    T, B, E = x_seq.shape
+    T, B, _ = x_seq.shape
     H = w.shape[1] // 4
     P = w_proj.shape[1]
     w_x, w_h = _split_w(w, w_proj)
-    xw = _hoisted_xw(x_seq, w_x, b)                    # [T, B, 4H] fp32
+    xw = _hoisted_xw(x_seq, w_x, b)              # [T, B, 4H] x dtype
     bt = min(batch_tile, B)
     while B % bt:
         bt -= 1
@@ -185,11 +192,11 @@ def _bwd(batch_tile, interpret, res, g):
 _lstm_scan_pallas.defvjp(_fwd, _bwd)
 
 
-def _vmem_fit_batch_tile(batch_tile, B, E, H, P, w_dtype, x_dtype,
-                         budget):
+def _vmem_fit_batch_tile(batch_tile, B, H, P, w_dtype, x_dtype, budget):
     """Largest bt <= batch_tile whose resident set fits the budget, or
     None. Resident: w_h + w_proj blocks (constant index -> kept), the
-    fp32 carry scratch, and double-buffered xw/out streaming tiles."""
+    fp32 carry scratch, and double-buffered xw/out streaming tiles
+    (both stored in the compute dtype)."""
     wsz = jnp.dtype(w_dtype).itemsize
     xsz = jnp.dtype(x_dtype).itemsize
     fixed = P * 4 * H * wsz + H * P * wsz              # w_h + w_proj
@@ -197,7 +204,7 @@ def _vmem_fit_batch_tile(batch_tile, B, E, H, P, w_dtype, x_dtype,
     while bt >= 1:
         if B % bt == 0:
             per_b = (bt * H * 4 + bt * P * 4           # c + h scratch
-                     + 2 * bt * 4 * H * 4              # xw blocks (fp32)
+                     + 2 * bt * 4 * H * xsz            # xw blocks
                      + 2 * bt * P * xsz)               # out blocks
             if fixed + per_b <= budget:
                 return bt
@@ -227,7 +234,7 @@ def lstm_scan(x_seq, w, b, w_proj, *, impl: str = "xla",
         return lstm_scan_reference(x_seq, w, b, w_proj)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    T, B, E = x_seq.shape
+    T, B, _ = x_seq.shape
     H = w.shape[1] // 4
     P = w_proj.shape[1]
     budget = int(os.environ.get("PARALLAX_LSTM_VMEM_BUDGET",
@@ -241,7 +248,7 @@ def lstm_scan(x_seq, w, b, w_proj, *, impl: str = "xla",
         axes = ((batch_axes,) if isinstance(batch_axes, str)
                 else tuple(batch_axes))
         n_shards = int(np.prod([mesh.shape[a] for a in axes]))
-    bt = _vmem_fit_batch_tile(batch_tile, max(1, B // n_shards), E, H, P,
+    bt = _vmem_fit_batch_tile(batch_tile, max(1, B // n_shards), H, P,
                               w.dtype, x_seq.dtype, budget)
     if not interpret and bt is None:
         wh_bytes = P * 4 * H * jnp.dtype(w.dtype).itemsize
@@ -260,7 +267,7 @@ def lstm_scan(x_seq, w, b, w_proj, *, impl: str = "xla",
     if mesh is None or batch_axes is None:
         return run(x_seq, w, b, w_proj)
     from jax.sharding import PartitionSpec as P_
-    return jax.shard_map(
+    return compat.shard_map(
         run, mesh=mesh,
         in_specs=(P_(None, batch_axes, None), P_(), P_(), P_()),
         out_specs=P_(None, batch_axes, None),

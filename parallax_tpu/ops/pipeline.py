@@ -53,6 +53,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD
+from parallax_tpu.common import compat
 
 
 def _rounded_microbatches(M: int, S: int, V: int) -> int:
@@ -156,9 +157,9 @@ def pipeline_apply(stage_fn: Callable,
             return stage_fn(pv, xx)
 
         act0 = jnp.zeros_like(xm[0])
-        outs0 = jax.lax.pcast(
+        outs0 = compat.pcast(
             jnp.zeros_like(xm), (AXIS_SHARD,), to="varying")
-        act0 = jax.lax.pcast(act0, (AXIS_SHARD,), to="varying")
+        act0 = compat.pcast(act0, (AXIS_SHARD,), to="varying")
 
         def tick(carry, t):
             act, outs = carry
@@ -197,7 +198,7 @@ def pipeline_apply(stage_fn: Callable,
     spec_params = jax.tree.map(
         lambda p: P(*((AXIS_SHARD,) + (None,) * (p.ndim - 1))),
         stage_params)
-    return jax.shard_map(
+    return compat.shard_map(
         local, mesh=mesh,
         in_specs=(spec_params, P(AXIS_REPL)),
         out_specs=P(AXIS_REPL),
@@ -301,12 +302,12 @@ def pipeline_value_and_grad(stage_fn: Callable,
         # those axes inserted by the transpose — a per-tick collective,
         # and a double-count with the one reduction we do at the end.
         my_params = jax.tree.map(
-            lambda p: jax.lax.pcast(p, (AXIS_REPL,), to="varying"),
+            lambda p: compat.pcast(p, (AXIS_REPL,), to="varying"),
             my_params)
 
         def vary_all(a):
             for ax in (AXIS_REPL, AXIS_SHARD):
-                a = jax.lax.pcast(a, (ax,), to="varying")
+                a = compat.pcast(a, (ax,), to="varying")
             return a
 
         head_v = jax.tree.map(vary_all, head_local)
@@ -405,7 +406,7 @@ def pipeline_value_and_grad(stage_fn: Callable,
         stage_params)
     head_specs = jax.tree.map(lambda _: P(), head_params)
     y_specs = jax.tree.map(lambda _: P(AXIS_REPL), y)
-    loss, g_stage, g_head, g_x = jax.shard_map(
+    loss, g_stage, g_head, g_x = compat.shard_map(
         local, mesh=mesh,
         in_specs=(spec_params, head_specs, P(AXIS_REPL), y_specs),
         out_specs=(P(), spec_params, head_specs, P(AXIS_REPL)),

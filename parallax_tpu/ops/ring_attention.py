@@ -34,6 +34,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from parallax_tpu.common import compat
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -129,7 +130,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         def pvary(x):
             if flash_interpret:
                 return x
-            return jax.lax.pcast(x, vary, to="varying")
+            return compat.pcast(x, vary, to="varying")
 
         m0 = pvary(jnp.full((B, H, Tq), _NEG_INF, jnp.float32))
         l0 = pvary(jnp.zeros((B, H, Tq), jnp.float32))
@@ -355,10 +356,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         m, l, o = accumulate(k_l, v_l, n - 1, m, l, o)
         return normalize(l, o)
 
-    return jax.shard_map(local, mesh=mesh,
+    # without the VMA system the legacy rep checker cannot be told the
+    # scan carry is device-varying (no pcast) and rejects the cond over
+    # ring steps — run it unchecked there, as jax itself advises
+    return compat.shard_map(local, mesh=mesh,
                          in_specs=(spec, spec, spec),
                          out_specs=spec,
-                         check_vma=not flash_interpret)(q, k, v)
+                         check_vma=(not flash_interpret
+                                    and compat.HAS_VMA))(q, k, v)
 
 
 def full_attention_reference(q, k, v, causal=False, scale=None):

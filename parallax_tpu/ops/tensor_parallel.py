@@ -55,8 +55,9 @@ def _active_mesh(mesh: Optional[Mesh], tp_axis: str) -> Optional[Mesh]:
 def heads_shardable(num_heads: int,
                     mesh: Optional[Mesh] = None,
                     tp_axis: str = AXIS_SHARD) -> bool:
-    """True when the head axis can be TP-sharded cleanly (head count
-    divides the shard-axis size). Pinning an indivisible head axis makes
+    """True when the head axis can be TP-sharded cleanly (the
+    shard-axis size divides the head count). Pinning an indivisible
+    head axis makes
     GSPMD pad it and pay an involuntary full rematerialization on every
     backward transpose (spmd_partitioner.cc:652 — VERDICT r4 weak item
     1); callers should fall back to a replicated attention core."""

@@ -14,6 +14,20 @@ Feed contract parity (session_context.py:205-233): each feed value may be
 Fetch contract: names among {"loss", "global_step"} ∪ the model's metric
 names; a single name returns a scalar, a list returns a list.
 
+Async step pipeline (ISSUE 1): the reference hides communication behind
+compute on the device; this layer hides the HOST behind the device too.
+``run()`` returns lazy ``Fetch`` handles instead of eagerly pulling every
+output to host, so dispatch never stalls on the previous step;
+``run_async()`` makes the handle explicit; ``run_iter()`` drives a whole
+batch iterator with feed conversion + host→device placement for batch
+t+1 running on a background thread (bounded depth,
+``ParallaxConfig.prefetch_depth``) while step t executes. Profiling
+steps and the partition search keep the old blocking semantics so their
+wall-times cover real device work; ``ParallaxConfig.eager_fetch=True``
+restores them everywhere. ``pipeline_stats`` (profiler.PipelineStats)
+records dispatch-gap / H2D-bytes / blocked-on-device per step so the
+overlap is measurable (bench.py) rather than assumed.
+
 The session also owns the per-step hooks the reference installs in the
 patched run: checkpoint triggers (chief-only hooks, lib.py:38-56), profile
 steps (session_context.py:74-92), step timing for the partition search
@@ -24,9 +38,11 @@ we re-jit and reshard in place).
 
 from __future__ import annotations
 
+import operator
 import time
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
 
+import jax
 import numpy as np
 
 from parallax_tpu.common import consts
@@ -34,8 +50,182 @@ from parallax_tpu.common.config import ParallaxConfig
 from parallax_tpu.common.lib import parallax_log
 from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
 from parallax_tpu.checkpoint import CheckpointHook
-from parallax_tpu.profiler import ProfileHook
+from parallax_tpu.profiler import PipelineStats, ProfileHook
 from parallax_tpu.parallel.partitions import PartitionSearch
+
+
+class Fetch:
+    """Lazy handle to one fetched value.
+
+    ``run()`` returns these (unless profiling / partition search /
+    ``eager_fetch`` force blocking): the value stays on device until the
+    first read, so the host thread is free to prepare batch *t+1*
+    instead of stalling on step *t*'s transfer. Any read —
+    ``result()``, ``float()``, ``int()``, ``np.asarray()``, arithmetic,
+    comparison, formatting — materializes the host value once and
+    caches it; ``shape`` / ``dtype`` / ``ndim`` / ``done()`` never
+    block. Matches ``run()``'s old return values exactly on first read
+    (scalars for 0-d outputs, ndarrays otherwise).
+    """
+
+    __slots__ = ("_raw", "_host", "_done", "_on_block", "_shape",
+                 "_dtype")
+
+    def __init__(self, value, on_block=None):
+        self._raw = value
+        self._host = None
+        self._done = False
+        self._on_block = on_block
+        # metadata frozen at creation so shape/dtype stay stable across
+        # materialization (a 0-d result becomes a Python scalar, whose
+        # numpy dtype would otherwise read back widened)
+        self._shape = tuple(np.shape(value))
+        self._dtype = getattr(value, "dtype", None)
+
+    def result(self):
+        """Materialize (blocking until the device value is ready) and
+        return the host value; cached after the first call."""
+        if not self._done:
+            t0 = time.perf_counter()
+            host = _to_host(self._raw)
+            if self._on_block is not None:
+                self._on_block(time.perf_counter() - t0)
+            self._host = host
+            self._done = True
+            self._raw = None
+            self._on_block = None
+        return self._host
+
+    def done(self) -> bool:
+        """Non-blocking: True when the value is ready on device (or
+        already materialized)."""
+        if self._done:
+            return True
+        is_ready = getattr(self._raw, "is_ready", None)
+        return bool(is_ready()) if callable(is_ready) else True
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def item(self):
+        return np.asarray(self.result()).item()
+
+    def __array__(self, dtype=None, copy=None):
+        return np.asarray(self.result(), dtype=dtype)
+
+    def __float__(self):
+        return float(self.result())
+
+    def __int__(self):
+        return int(self.result())
+
+    def __index__(self):
+        return operator.index(self.result())
+
+    def __bool__(self):
+        return bool(self.result())
+
+    def __format__(self, spec):
+        return format(self.result(), spec)
+
+    def __repr__(self):
+        if self._done:
+            return f"Fetch({self._host!r})"
+        return "Fetch(<pending>)"
+
+    # value semantics on read: comparisons/arithmetic materialize, so
+    # existing driver code (`loss < best`, `0.5 * loss`) works unchanged
+    __hash__ = None
+
+    def _binop(op, swap=False):  # noqa: N805 — descriptor factory
+        def fn(self, other):
+            if isinstance(other, Fetch):
+                other = other.result()
+            a = self.result()
+            return op(other, a) if swap else op(a, other)
+        fn.__name__ = ("__r" if swap else "__") + op.__name__ + "__"
+        return fn
+
+    __lt__ = _binop(operator.lt)
+    __le__ = _binop(operator.le)
+    __gt__ = _binop(operator.gt)
+    __ge__ = _binop(operator.ge)
+    __eq__ = _binop(operator.eq)
+    __ne__ = _binop(operator.ne)
+    __add__ = _binop(operator.add)
+    __radd__ = _binop(operator.add, swap=True)
+    __sub__ = _binop(operator.sub)
+    __rsub__ = _binop(operator.sub, swap=True)
+    __mul__ = _binop(operator.mul)
+    __rmul__ = _binop(operator.mul, swap=True)
+    __truediv__ = _binop(operator.truediv)
+    __rtruediv__ = _binop(operator.truediv, swap=True)
+    __floordiv__ = _binop(operator.floordiv)
+    __rfloordiv__ = _binop(operator.floordiv, swap=True)
+    __mod__ = _binop(operator.mod)
+    __rmod__ = _binop(operator.mod, swap=True)
+    __pow__ = _binop(operator.pow)
+    __rpow__ = _binop(operator.pow, swap=True)
+    del _binop
+
+    def __neg__(self):
+        return -self.result()
+
+    def __pos__(self):
+        return +self.result()
+
+    def __abs__(self):
+        return abs(self.result())
+
+
+def materialize(value):
+    """Resolve every ``Fetch`` inside a run() result (scalar / list /
+    dict) to its host value; non-Fetch values pass through."""
+    if isinstance(value, Fetch):
+        return value.result()
+    if isinstance(value, dict):
+        return {k: materialize(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        items = [materialize(v) for v in value]
+        if hasattr(value, "_fields"):  # namedtuple: one arg per field
+            return type(value)(*items)
+        return type(value)(items)
+    return value
+
+
+class StepHandle:
+    """Returned by ``run_async()``: the step is already dispatched;
+    ``result()`` blocks until every fetched value is on host and
+    returns exactly what a blocking ``run()`` would have."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value):
+        self._value = value
+
+    def done(self) -> bool:
+        """Non-blocking readiness of every fetch in the result."""
+        def ready(v):
+            if isinstance(v, Fetch):
+                return v.done()
+            if isinstance(v, dict):
+                return all(ready(x) for x in v.values())
+            if isinstance(v, (list, tuple)):
+                return all(ready(x) for x in v)
+            return True
+        return ready(self._value)
+
+    def result(self):
+        return materialize(self._value)
 
 
 class ParallaxSession:
@@ -64,6 +254,10 @@ class ParallaxSession:
         self._host_step = 0
         from collections import deque
         self._recent_times = deque(maxlen=20)
+        # async pipeline state
+        self.pipeline_stats = PipelineStats()
+        self._last_dispatch_end: Optional[float] = None
+        self._prefetcher = None
 
     # -- lazy build (needs the first batch to know shapes) ----------------
 
@@ -96,7 +290,6 @@ class ParallaxSession:
         names re-bound to the new mesh (axis names are stable across
         plans), so e.g. adam's mu/nu follow their sparse param's new
         shard count instead of staying on the old mesh."""
-        import jax
         from jax.sharding import NamedSharding
         new_mesh = self._engine.mesh
         new_params = jax.device_put(state.params,
@@ -131,25 +324,152 @@ class ParallaxSession:
                 "fetch-only runs have no meaning under SPMD")
         batch = self._convert_feed(feed_dict)
         self._ensure_engine(batch)
+        return self._run_step(fetches, batch)
 
+    def run_async(self, fetches: Union[None, str, Sequence[str]] = None,
+                  feed_dict: Optional[Dict[str, Any]] = None
+                  ) -> StepHandle:
+        """``run()`` with the future made explicit: dispatches one step
+        and returns a ``StepHandle`` immediately; ``handle.result()``
+        blocks until the fetches are on host and returns exactly what a
+        blocking ``run()`` would. Ignores ``eager_fetch`` (the whole
+        point is not to block); profiling steps / the partition search
+        still block inside the dispatch so their timings stay honest."""
+        if feed_dict is None:
+            raise ValueError(
+                "ParallaxSession.run_async requires feed_dict (the "
+                "batch); fetch-only runs have no meaning under SPMD")
+        batch = self._convert_feed(feed_dict)
+        self._ensure_engine(batch)
+        return StepHandle(self._run_step(fetches, batch, force_lazy=True))
+
+    def run_iter(self, batches: Iterable[Dict[str, Any]],
+                 fetches: Union[None, str, Sequence[str]] = None,
+                 placed: bool = False):
+        """Pipelined training loop: yields one ``run()`` result per feed
+        dict from ``batches``, with feed conversion, ``feed_transforms``
+        and host→device placement for batch *t+1* running on a bounded
+        background thread (depth ``ParallaxConfig.prefetch_depth``)
+        while step *t* executes on device. Results come back in batch
+        order with the exact ``run()`` fetch contract — same losses,
+        bit for bit, as the sequential loop.
+
+        ``placed=True`` skips the internal prefetcher and treats each
+        item as already device-placed (chain
+        ``data.prefetch_to_device(batches, session.place_batch)`` for
+        an external pipeline, e.g. straight off the native token
+        loader's thread).
+
+        While the partition auto-search is live the loop stays
+        sequential (a replan rebuilds the mesh, which would invalidate
+        in-flight placed batches) and upgrades to prefetching the step
+        after the search settles. Exceptions from the iterator or the
+        prefetch thread surface here, at the step that would have
+        consumed the failed batch; closing the generator (or
+        ``session.close()``) shuts the thread down."""
+        # validate placed=True misuse HERE, not at the first next(): a
+        # generator body only runs on iteration, which can be far from
+        # the offending call site
+        if placed and self._search is not None:
+            # a replan would rebuild the mesh under batches the
+            # external pipeline already placed for the old one
+            raise ValueError(
+                "run_iter(placed=True) cannot run while the "
+                "partition auto-search is live: a replan would "
+                "invalidate already-placed batches. Finish the "
+                "search first (or disable search_partitions).")
+        return self._run_iter_gen(iter(batches), fetches, placed)
+
+    def _run_iter_gen(self, it, fetches, placed):
+        if placed:
+            for batch in it:
+                # checked per batch, not at call time: the documented
+                # prefetch_to_device chaining builds the engine lazily
+                # on ITS background thread (place_batch), and the queue
+                # hand-off guarantees it exists once a batch arrives —
+                # only batches placed by other means can get here first
+                if self._engine is None:
+                    raise ValueError(
+                        "run_iter(placed=True) got a batch but no "
+                        "engine exists: place batches via "
+                        "session.place_batch (which builds it) or "
+                        "call prepare(example_feed) first")
+                yield self._run_step(fetches, batch, placed=True)
+            return
+        # sequential while the partition search may rebuild the mesh
+        while self._search is not None:
+            try:
+                feed = next(it)
+            except StopIteration:
+                return
+            batch = self._convert_feed(feed)
+            self._ensure_engine(batch)
+            yield self._run_step(fetches, batch)
+        from parallax_tpu.data.prefetch import Prefetcher
+        prefetcher = Prefetcher(it, self.place_batch,
+                                depth=int(self._config.prefetch_depth),
+                                name="parallax-feed-prefetch")
+        self._prefetcher = prefetcher
+        try:
+            for batch in prefetcher:
+                yield self._run_step(fetches, batch, placed=True)
+        finally:
+            prefetcher.close()
+            if self._prefetcher is prefetcher:
+                # a stale generator's finalization must not clobber the
+                # tracking of a newer run_iter's live prefetcher
+                self._prefetcher = None
+
+    def place_batch(self, feed_dict: Dict[str, Any]):
+        """Convert one feed dict (per-replica lists, ``feed_transforms``)
+        and place it onto the mesh — everything ``run()`` does before
+        dispatch, without the step. Safe to call from a background
+        thread once the engine exists; builds the engine on first use.
+        Feed the result to ``run_iter(..., placed=True)`` or
+        ``engine.step(state, batch, preplaced=True)``."""
+        batch = self._convert_feed(feed_dict)
+        self._ensure_engine(batch)
+        self.pipeline_stats.record_h2d(_feed_nbytes(batch))
+        return self._engine.shard_batch(batch)
+
+    def _run_step(self, fetches, batch, placed: bool = False,
+                  force_lazy: bool = False):
+        """Dispatch one step on an already-converted (and possibly
+        already-placed) batch; shared by run/run_async/run_iter."""
         step = self._host_step
         self._profile.before_step(step)
         t0 = time.perf_counter()
-        self._state, outputs = self._engine.step(self._state, batch)
-        if self._search is not None or self._profile.active:
+        gap = (None if self._last_dispatch_end is None
+               else t0 - self._last_dispatch_end)
+        if not placed:
+            self.pipeline_stats.record_h2d(_feed_nbytes(batch))
+        self._state, outputs = self._engine.step(self._state, batch,
+                                                 preplaced=placed)
+        # debug_nans blocks too: its contract is "raise at the step that
+        # produced the NaN", which lazy fetches would defer to whatever
+        # later line first reads a value
+        blocking = (self._search is not None or self._profile.active
+                    or self._config.debug_nans
+                    or (self._config.eager_fetch and not force_lazy))
+        if blocking:
             # Block so step timing / traces cover real device work.
+            tb = time.perf_counter()
             outputs = {k: np.asarray(v) for k, v in outputs.items()}
-        dt = time.perf_counter() - t0
+            self.pipeline_stats.record_blocked(time.perf_counter() - tb)
+        now = time.perf_counter()
+        dt = now - t0
+        self._last_dispatch_end = now
+        self.pipeline_stats.record_dispatch(gap, dt)
         self._profile.after_step(step)
         self._last_outputs = outputs
-        self._recent_times.append(time.perf_counter())
+        self._recent_times.append(now)
         new_step = step + 1
         self._host_step = new_step
         if self._ckpt.maybe_save(new_step, self._state):
             self._warn_sparse_overflow("checkpoint")
         if self._search is not None:
             self._record_search_time(dt)
-        return self._convert_fetch(fetches, outputs)
+        return self._convert_fetch(fetches, outputs, lazy=not blocking)
 
     @property
     def state(self):
@@ -189,7 +509,6 @@ class ParallaxSession:
             return
         mean_t = float(np.mean(self._step_times[warm:test]))
         self._step_times = []
-        import jax
         if jax.process_count() > 1:
             # All processes must take identical re-plan decisions (they
             # jit the same mesh), so agree on one timing: the average
@@ -233,12 +552,17 @@ class ParallaxSession:
         self._last_example_batch = batch
         return batch
 
-    def _convert_fetch(self, fetches, outputs):
+    def _convert_fetch(self, fetches, outputs, lazy: bool = False):
+        if lazy:
+            record = self.pipeline_stats.record_blocked
+            wrap = lambda v: Fetch(v, record)  # noqa: E731
+        else:
+            wrap = _to_host
         if fetches is None:
-            return {k: _to_host(v) for k, v in outputs.items()}
+            return {k: wrap(v) for k, v in outputs.items()}
         if isinstance(fetches, str):
-            return _to_host(self._one(fetches, outputs))
-        return [_to_host(self._one(f, outputs)) for f in fetches]
+            return wrap(self._one(fetches, outputs))
+        return [wrap(self._one(f, outputs)) for f in fetches]
 
     def _one(self, name, outputs):
         if name not in outputs:
@@ -259,6 +583,9 @@ class ParallaxSession:
                 "Raise max_touched_rows.", n, where)
 
     def close(self):
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
         self._warn_sparse_overflow("close")
         self._ckpt.close()
         if self._engine is not None:
@@ -268,3 +595,13 @@ class ParallaxSession:
 def _to_host(v):
     arr = np.asarray(v)
     return arr.item() if arr.ndim == 0 else arr
+
+
+def _feed_nbytes(batch) -> int:
+    """Per-step H2D volume: bytes of the converted host feed. Measured
+    BEFORE feed_transforms (which run inside shard_batch), so a
+    transform that pads or re-dtypes a feed shifts the true shipped
+    volume off this number by the same factor on every step — the
+    metric stays valid for trend/regression comparison."""
+    return sum(int(getattr(leaf, "nbytes", 0))
+               for leaf in jax.tree_util.tree_leaves(batch))

@@ -1,0 +1,387 @@
+"""Async step pipeline (ISSUE 1): lazy fetches, run_async, run_iter
+prefetch — equivalence with the sequential blocking loop (bitwise),
+bounded prefetch depth and ordering, exception propagation out of the
+prefetch thread, clean shutdown, and a wall-clock overlap win with an
+artificially slow feed transform."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import parallax_tpu as parallax
+from parallax_tpu.data import prefetch_to_device
+from parallax_tpu.data.prefetch import Prefetcher
+from parallax_tpu.models import simple
+from parallax_tpu.session import Fetch, StepHandle
+
+
+def _simple_session(**cfg_kw):
+    sess, *_ = parallax.parallel_run(
+        simple.build_model(learning_rate=0.1),
+        parallax_config=parallax.Config(run_option="AR",
+                                        search_partitions=False,
+                                        **cfg_kw))
+    return sess
+
+
+def _batches(n, batch=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return [simple.make_batch(rng, batch) for _ in range(n)]
+
+
+# -- Prefetcher unit behavior ---------------------------------------------
+
+
+class TestPrefetcher:
+    def test_order_and_completeness(self):
+        with Prefetcher(range(50), lambda x: x * 2, depth=3) as pf:
+            assert list(pf) == [2 * i for i in range(50)]
+
+    def test_bounded_depth(self):
+        produced = []
+
+        def place(x):
+            produced.append(x)
+            return x
+
+        out = []
+        with Prefetcher(range(30), place, depth=2) as pf:
+            for v in pf:
+                time.sleep(0.005)  # slow consumer: let the worker race
+                # ahead of `out`: the yielded item (1) + queue (depth) +
+                # at most one in flight inside place()
+                assert len(produced) - len(out) <= 1 + 2 + 1
+                out.append(v)
+        assert out == list(range(30))
+
+    def test_source_exception_propagates(self):
+        def source():
+            yield from range(3)
+            raise RuntimeError("boom at 3")
+
+        pf = Prefetcher(source(), depth=2)
+        got = [next(pf), next(pf), next(pf)]
+        assert got == [0, 1, 2]
+        with pytest.raises(RuntimeError, match="boom at 3"):
+            next(pf)
+        # terminal: the failed pipeline stays stopped
+        with pytest.raises(StopIteration):
+            next(pf)
+
+    def test_place_fn_exception_propagates(self):
+        def place(x):
+            if x == 2:
+                raise ValueError("bad batch 2")
+            return x
+
+        pf = Prefetcher(range(10), place, depth=2)
+        assert [next(pf), next(pf)] == [0, 1]
+        with pytest.raises(ValueError, match="bad batch 2"):
+            next(pf)
+        pf.close()
+
+    def test_close_stops_worker_promptly(self):
+        def endless():
+            i = 0
+            while True:
+                yield i
+                i += 1
+
+        pf = Prefetcher(endless(), depth=2)
+        assert next(pf) == 0
+        assert pf.alive
+        pf.close()
+        assert not pf.alive
+        pf.close()  # idempotent
+
+
+# -- lazy fetches ----------------------------------------------------------
+
+
+class TestLazyFetch:
+    def test_run_returns_lazy_handles_with_value_semantics(self):
+        sess = _simple_session()
+        try:
+            (b,) = _batches(1)
+            loss, step = sess.run(["loss", "global_step"], feed_dict=b)
+            assert isinstance(loss, Fetch) and isinstance(step, Fetch)
+            # reads materialize: numerics/comparisons/formatting all work
+            assert step == 1 and int(step) == 1
+            assert np.isfinite(float(loss))
+            assert np.isfinite(np.asarray(loss))
+            assert 0.5 * loss + 1.0 > 0
+            assert "{:.3f}".format(loss)
+            assert loss.ndim == 0 and loss.done()
+            # dict fetch + single-name fetch keep their shapes
+            out = sess.run(None, feed_dict=b)
+            assert set(out) >= {"loss", "global_step"}
+            assert isinstance(out["loss"], Fetch)
+            single = sess.run("loss", feed_dict=b)
+            assert isinstance(single, Fetch)
+            # materialize() resolves whole structures
+            host = parallax.materialize(out)
+            assert isinstance(host["loss"], float)
+        finally:
+            sess.close()
+
+    def test_eager_fetch_restores_blocking_values(self):
+        sess = _simple_session(eager_fetch=True)
+        try:
+            (b,) = _batches(1)
+            loss, step = sess.run(["loss", "global_step"], feed_dict=b)
+            assert isinstance(loss, float) and step == 1
+        finally:
+            sess.close()
+
+    def test_lazy_matches_eager_bitwise(self):
+        batches = _batches(10)
+        eager = _simple_session(eager_fetch=True)
+        try:
+            want = [eager.run("loss", feed_dict=b) for b in batches]
+        finally:
+            eager.close()
+        lazy = _simple_session()
+        try:
+            handles = [lazy.run("loss", feed_dict=b) for b in batches]
+            got = [float(h) for h in handles]
+        finally:
+            lazy.close()
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_run_async_handle(self):
+        sess = _simple_session()
+        try:
+            (b,) = _batches(1)
+            h = sess.run_async(["loss", "global_step"], feed_dict=b)
+            assert isinstance(h, StepHandle)
+            loss, step = h.result()
+            assert isinstance(loss, float) and step == 1
+            assert h.done()
+        finally:
+            sess.close()
+
+
+# -- run_iter: pipelined loop ---------------------------------------------
+
+
+class TestRunIter:
+    def test_matches_sequential_run_bitwise_in_order(self):
+        batches = _batches(12)
+        seq = _simple_session(eager_fetch=True)
+        try:
+            want = [seq.run(["loss", "global_step"], feed_dict=b)
+                    for b in batches]
+        finally:
+            seq.close()
+        pipe = _simple_session(prefetch_depth=3)
+        try:
+            got = [parallax.materialize(r) for r in
+                   pipe.run_iter(batches, ["loss", "global_step"])]
+        finally:
+            pipe.close()
+        assert [s for _, s in got] == list(range(1, 13))  # in order
+        np.testing.assert_array_equal(
+            np.asarray([l for l, _ in got]),
+            np.asarray([l for l, _ in want]))
+
+    def test_placed_batches_roundtrip(self):
+        """External pipeline: prefetch_to_device chained onto
+        place_batch feeds run_iter(placed=True)."""
+        batches = _batches(6)
+        seq = _simple_session(eager_fetch=True)
+        try:
+            want = [seq.run("loss", feed_dict=b) for b in batches]
+        finally:
+            seq.close()
+        sess = _simple_session()
+        try:
+            # no prepare(): the documented chaining builds the engine
+            # lazily on the prefetch thread's first place_batch call
+            with prefetch_to_device(batches, sess.place_batch,
+                                    depth=2) as placed:
+                got = [float(r) for r in
+                       sess.run_iter(placed, "loss", placed=True)]
+        finally:
+            sess.close()
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_iterator_exception_surfaces(self):
+        sess = _simple_session()
+        try:
+            def source():
+                yield from _batches(3)
+                raise ValueError("feed pipeline died")
+
+            gen = sess.run_iter(source(), "loss")
+            got = [next(gen), next(gen), next(gen)]
+            assert all(np.isfinite(float(g)) for g in got)
+            with pytest.raises(ValueError, match="feed pipeline died"):
+                next(gen)
+        finally:
+            sess.close()
+
+    def test_transform_exception_surfaces_from_prefetch_thread(self):
+        model = simple.build_model(learning_rate=0.1)
+        calls = []
+
+        def bad_transform(x, mesh):
+            calls.append(threading.current_thread().name)
+            if len(calls) == 3:
+                raise RuntimeError("transform blew up")
+            return x
+
+        model.feed_transforms["x"] = bad_transform
+        sess, *_ = parallax.parallel_run(
+            model, parallax_config=parallax.Config(
+                run_option="AR", search_partitions=False))
+        try:
+            gen = sess.run_iter(_batches(6), "loss")
+            got = [next(gen), next(gen)]
+            assert all(np.isfinite(float(g)) for g in got)
+            with pytest.raises(RuntimeError, match="transform blew up"):
+                list(gen)
+            # the failing call ran on the prefetch thread, not the
+            # dispatch thread
+            assert any("prefetch" in name for name in calls)
+        finally:
+            sess.close()
+
+    def test_close_shuts_down_prefetch_thread(self):
+        sess = _simple_session()
+        rng = np.random.default_rng(0)
+
+        def endless():
+            while True:
+                yield simple.make_batch(rng, 64)
+
+        gen = sess.run_iter(endless(), "loss")
+        next(gen)
+        next(gen)
+        pf = sess._prefetcher
+        assert pf is not None and pf.alive
+        sess.close()
+        assert not pf.alive
+        gen.close()  # generator finalization after close stays clean
+        assert sess._prefetcher is None
+
+    def test_pipeline_stats_populated(self):
+        sess = _simple_session()
+        try:
+            list(sess.run_iter(_batches(8), fetches=[]))
+            s = sess.pipeline_stats.summary()
+            assert s["steps"] == 8
+            assert s["h2d_bytes_per_step"] > 0
+            assert s["dispatch"]["mean_ms"] >= 0
+            assert s["dispatch_gap"]["mean_ms"] >= 0
+        finally:
+            sess.close()
+
+
+# -- the overlap win -------------------------------------------------------
+
+
+def _heavy_model(dim=256, iters=4):
+    """A step heavy enough (tens of ms on the CPU rig) that hiding feed
+    prep behind it is measurable."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def init_fn(rng):
+        return {"w": jax.random.normal(rng, (dim, dim),
+                                       jnp.float32) * 0.05}
+
+    def loss_fn(params, batch):
+        y = batch["x"]
+        for _ in range(iters):
+            y = jnp.tanh(y @ params["w"])
+        return jnp.mean((y - batch["y"]) ** 2)
+
+    return parallax.Model(init_fn, loss_fn, optimizer=optax.sgd(0.01)), dim
+
+
+class TestOverlap:
+    N_STEPS = 10
+    SLEEP = 0.03
+
+    def _run_both(self):
+        dim_batches = None
+        times, losses, prep_starts, mat_done = {}, {}, [], []
+        for mode in ("sequential", "pipelined"):
+            model, dim = _heavy_model()
+            sleep = self.SLEEP
+
+            def slow_transform(x, mesh, _starts=prep_starts,
+                               _mode=mode):
+                if _mode == "pipelined":
+                    _starts.append(time.perf_counter())
+                time.sleep(sleep)
+                return x
+
+            model.feed_transforms["x"] = slow_transform
+            if dim_batches is None:
+                rng = np.random.default_rng(3)
+                dim_batches = [
+                    {"x": rng.standard_normal((64, dim)).astype(
+                        np.float32),
+                     "y": rng.standard_normal((64, dim)).astype(
+                         np.float32)}
+                    for _ in range(self.N_STEPS)]
+            sess, *_ = parallax.parallel_run(
+                model, parallax_config=parallax.Config(
+                    run_option="AR", search_partitions=False,
+                    eager_fetch=(mode == "sequential")))
+            try:
+                sess.run("loss", feed_dict=dim_batches[0])  # compile
+                t0 = time.perf_counter()
+                if mode == "sequential":
+                    # the pre-async loop: blocking fetch every step
+                    ls = [sess.run("loss", feed_dict=b)
+                          for b in dim_batches]
+                else:
+                    ls = []
+                    for f in sess.run_iter(dim_batches, "loss"):
+                        ls.append(float(f))  # materialize step t...
+                        mat_done.append(time.perf_counter())
+                times[mode] = time.perf_counter() - t0
+                losses[mode] = [float(x) for x in ls]
+            finally:
+                sess.close()
+        return times, losses, prep_starts, mat_done
+
+    def test_pipelined_overlaps_and_matches_bitwise(self):
+        # the wall-time margin is a PERF assertion on a possibly-loaded
+        # CI box (typical ratio ~0.55, contended tail ~0.87): give it
+        # one retry. Correctness (bitwise equality) must hold on EVERY
+        # attempt and never gets a retry.
+        last_exc = None
+        for _attempt in range(2):
+            times, losses, prep_starts, mat_done = self._run_both()
+            # identical math: the pipeline reorders WORK, never results
+            np.testing.assert_array_equal(
+                np.asarray(losses["pipelined"]),
+                np.asarray(losses["sequential"]))
+            # feed prep for batch t+1 started before step t's result
+            # was materialized (true overlap, not just reordering):
+            # prep_starts has one entry per batch incl. the
+            # compile-step batch. A sequential loop scores 0 here;
+            # require a solid majority rather than all() so a starved
+            # prefetch thread can drop a pair without flaking the test
+            overlap_pairs = [
+                t_prep < t_mat
+                for t_prep, t_mat in zip(prep_starts[2:], mat_done)]
+            try:
+                assert overlap_pairs
+                assert sum(overlap_pairs) >= 0.7 * len(overlap_pairs), \
+                    overlap_pairs
+                # the overlap is worth real wall-time: with feed prep
+                # (SLEEP) comparable to the step, hiding one behind the
+                # other must beat the serial sum by a clear margin
+                assert times["pipelined"] < 0.9 * times["sequential"], \
+                    times
+                return
+            except AssertionError as e:
+                last_exc = e
+        raise last_exc

@@ -78,6 +78,72 @@ class TestResourceInfo:
         assert parse_resource_info(None) == [HostInfo("localhost")]
 
 
+class TestIsLocalHost:
+    def test_loopback_literals(self):
+        from parallax_tpu.common.lib import is_local_host
+        assert is_local_host("localhost")
+        assert is_local_host("127.0.0.1")
+        # whole 127/8 network: the N-process CPU rigs name
+        # 127.0.0.2/127.0.0.3/... for distinct local workers
+        assert is_local_host("127.0.0.2")
+        assert is_local_host("::1")
+
+    def test_hostname_that_merely_starts_with_127_is_not_loopback(self):
+        from parallax_tpu.common.lib import is_local_host
+        # ADVICE r5: "127.example.com" is a resolvable NAME, not an IP
+        # literal — it must take the resolver path, not the shortcut
+        assert not is_local_host("127.example.com")
+        assert not is_local_host("10.0.0.1")
+
+    def test_own_hostname_is_local(self):
+        import socket
+        from parallax_tpu.common.lib import is_local_host
+        assert is_local_host(socket.gethostname())
+
+
+class TestBenchRelayAddr:
+    """bench._relay_addr honors AXON_POOL_SVC_OVERRIDE (ADVICE r5)."""
+
+    @pytest.fixture
+    def relay_addr(self):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "_bench_under_test",
+            os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod._relay_addr
+
+    def test_default(self, relay_addr, monkeypatch):
+        monkeypatch.delenv("AXON_POOL_SVC_OVERRIDE", raising=False)
+        assert relay_addr() == ("127.0.0.1", 8083)
+
+    def test_host_only_keeps_default_port(self, relay_addr, monkeypatch):
+        monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", "192.0.2.7")
+        assert relay_addr() == ("192.0.2.7", 8083)
+
+    def test_host_port(self, relay_addr, monkeypatch):
+        monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", "relay.local:9090")
+        assert relay_addr() == ("relay.local", 9090)
+
+    def test_bracketed_ipv6(self, relay_addr, monkeypatch):
+        monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", "[::1]:8084")
+        assert relay_addr() == ("::1", 8084)
+
+    def test_url_form_and_bad_port_never_leak_colons(self, relay_addr,
+                                                     monkeypatch):
+        # a ':' left in the host would flip the readiness probe to an
+        # AF_INET6 socket against a non-v6 name
+        monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE",
+                           "http://relay.local:9090/init")
+        assert relay_addr() == ("relay.local", 9090)
+        monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", "relay.local:http")
+        assert relay_addr() == ("relay.local", 8083)
+        monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", ":8084")
+        assert relay_addr() == ("127.0.0.1", 8084)
+
+
 class TestShardAPI:
     def test_mod_filter_semantics(self):
         # reference shard.py:69-87: elem index % num_shards == shard_id

@@ -130,13 +130,13 @@ class TestFlagshipSize:
 
     def test_vmem_fit_passes_flagship_bf16(self):
         bt = pallas_lstm._vmem_fit_batch_tile(
-            128, 128, self.FE, self.FH, self.FP,
+            128, 128, self.FH, self.FP,
             jnp.bfloat16, jnp.bfloat16, 12 * 1024 * 1024)
         assert bt is not None and 128 % bt == 0
         # and the guard still refuses when the RESIDENT set alone
         # (recurrent matrix at 4x the hidden) cannot fit
         assert pallas_lstm._vmem_fit_batch_tile(
-            128, 128, self.FE, 4 * self.FH, 4 * self.FP,
+            128, 128, 4 * self.FH, 4 * self.FP,
             jnp.bfloat16, jnp.bfloat16, 12 * 1024 * 1024) is None
 
     def test_flagship_weight_shape_parity(self, rng):
