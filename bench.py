@@ -228,7 +228,8 @@ def main():
 
 
 def _run(model, cfg, batch_size, num_steps, steps, warmup, run_option,
-         wire_stats=None, pipeline_stats=None):
+         wire_stats=None, pipeline_stats=None, metrics_out=None,
+         monitor_health=False):
     import jax
     import numpy as np
     import parallax_tpu as parallax
@@ -237,7 +238,12 @@ def _run(model, cfg, batch_size, num_steps, steps, warmup, run_option,
     sess, *_ = parallax.parallel_run(
         model, parallax_config=parallax.Config(
             run_option=run_option, search_partitions=False,
-            sparse_grad_mode="slices"))
+            sparse_grad_mode="slices",
+            # health OFF on the timed runs: the in-graph grad-norm would
+            # make the headline incomparable to rounds measured without
+            # it — worker_main stamps health.* from a separate untimed
+            # probe run instead
+            monitor_health=monitor_health))
     try:
         rng = np.random.default_rng(0)
         batches = [lm1b.make_batch(rng, batch_size, num_steps,
@@ -274,6 +280,11 @@ def _run(model, cfg, batch_size, num_steps, steps, warmup, run_option,
             # measured window (the overlap observability this bench
             # guards; regressions show up as a growing dispatch gap)
             pipeline_stats.update(sess.pipeline_stats.summary())
+        if metrics_out is not None:
+            # the full metrics-registry snapshot (ISSUE 2): pipeline.*,
+            # engine recompiles, health.* (grad norm / loss finiteness),
+            # device memory gauges where the backend reports them
+            metrics_out.update(sess.metrics_snapshot())
         return words / dt
     finally:
         # free HBM even on OOM so the retry loop's smaller attempt
@@ -323,8 +334,10 @@ def worker_main():
     # Headline: hybrid engine at the realistic batch size.
     wire = {}
     pipe = {}
+    metrics_snap = {}
     hybrid_wps = _run(lm1b.build_model(cfg), cfg, bs, T, steps, warmup,
-                      "HYBRID", wire_stats=wire, pipeline_stats=pipe)
+                      "HYBRID", wire_stats=wire, pipeline_stats=pipe,
+                      metrics_out=metrics_snap)
     # Baseline comparison at a common batch size both paths can run. The
     # full-softmax baseline materializes [B*T, V] logits; retry smaller
     # if it doesn't fit rather than losing the whole headline.
@@ -349,6 +362,23 @@ def worker_main():
             try_bs //= 2
     # vs_baseline stays None (JSON null) if the baseline never ran —
     # never fabricate a parity number
+
+    # Health probe (untimed): grad-norm / loss-finite flow through the
+    # registry on a short run with monitor_health=True; merged into the
+    # stamped snapshot so the BENCH JSON carries them without the
+    # in-graph norm compute touching any timed window. Costs one extra
+    # engine compile — PARALLAX_BENCH_HEALTH=0 skips it when that
+    # matters more than the health keys (e.g. a quick TPU spot-check).
+    if os.environ.get("PARALLAX_BENCH_HEALTH", "1") != "0":
+        try:
+            health_snap = {}
+            _run(lm1b.build_model(cfg), cfg, small_bs, T, 6, 2, "HYBRID",
+                 metrics_out=health_snap, monitor_health=True)
+            metrics_snap.update({k: v for k, v in health_snap.items()
+                                 if k.startswith("health.")})
+        except Exception as e:
+            print(f"# health probe failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
 
     per_chip = hybrid_wps / n_chips
     # MFU: analytic matmul FLOPs per word (fwd+bwd) over the chip's
@@ -377,8 +407,16 @@ def worker_main():
         "flops_per_step": fpw * bs * T,
         "device_peak_flops": peak,
         "mfu": round(mfu, 4) if mfu is not None else None,
-        # async-pipeline health over the headline window (PipelineStats)
+        # async-pipeline health over the headline window. Kept ALONGSIDE
+        # the registry snapshot below (which carries the same pipeline.*
+        # data in histogram form) for cross-round continuity: BENCH_r0x
+        # consumers read this key; drop it once comparisons re-baseline.
         "pipeline": pipe or None,
+        # metrics-registry snapshot over the headline window (obs/):
+        # pipeline.* overlap signals, steps/sec, engine recompiles,
+        # health grad-norm / loss-finite (untimed probe run), device
+        # memory when the backend reports it
+        "metrics": metrics_snap or None,
     }
     if wire.get("dense_allreduce_bytes"):
         # north-star secondary metric: sparse-grad bytes on wire per step

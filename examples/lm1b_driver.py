@@ -50,6 +50,17 @@ def main():
                     choices=["xla", "pallas"],
                     help="'pallas' = VMEM-resident recurrence kernel "
                          "(ops/pallas_lstm.py)")
+    ap.add_argument("--trace_path", default=None,
+                    help="write a chrome://tracing JSON of the host "
+                         "pipeline (dispatch/prefetch/fetch spans) at "
+                         "close")
+    ap.add_argument("--metrics_path", default=None,
+                    help="append metrics-registry snapshots as JSONL "
+                         "every --metrics_interval_s seconds")
+    ap.add_argument("--metrics_interval_s", type=float, default=10.0)
+    ap.add_argument("--monitor_health", action="store_true",
+                    help="in-graph loss-finiteness + grad-norm "
+                         "monitoring (lazily fetched; warns on NaN)")
     args = ap.parse_args()
 
     num_partitions = parallax.get_partitioner(args.partitions)
@@ -63,6 +74,10 @@ def main():
     config = parallax.Config(
         run_option=args.run_option,
         sparse_grad_mode=args.sparse_grad_mode,
+        trace_path=args.trace_path,
+        metrics_path=args.metrics_path,
+        metrics_interval_s=args.metrics_interval_s,
+        monitor_health=args.monitor_health,
         ckpt_config=parallax.CheckPointConfig(
             ckpt_dir=args.ckpt_dir,
             save_ckpt_steps=args.save_ckpt_steps,
@@ -106,6 +121,9 @@ def main():
             wps = words_acc / (now - t_last)
             pending_words, t_last = [], now
             print(f"step {step}: loss {loss:.4f}  {wps:,.0f} words/sec")
+    if args.monitor_health:
+        import json
+        print("health:", json.dumps(sess.health.report()))
     sess.close()
 
 

@@ -27,11 +27,21 @@ def main():
     ap.add_argument("--batch_size", type=int, default=64)
     ap.add_argument("--run_option", default="HYBRID",
                     choices=["AR", "SHARD", "HYBRID", "MPI", "PS"])
+    ap.add_argument("--trace_path", default=None,
+                    help="write a chrome://tracing JSON of the host "
+                         "pipeline spans at close")
+    ap.add_argument("--metrics_path", default=None,
+                    help="append metrics-registry snapshots as JSONL")
+    ap.add_argument("--monitor_health", action="store_true",
+                    help="in-graph loss-finite + grad-norm monitoring")
     args = ap.parse_args()
 
     model = simple.build_model(learning_rate=0.1)
     config = parallax.Config(run_option=args.run_option,
-                             search_partitions=False)
+                             search_partitions=False,
+                             trace_path=args.trace_path,
+                             metrics_path=args.metrics_path,
+                             monitor_health=args.monitor_health)
     sess, num_workers, worker_id, num_replicas = parallax.parallel_run(
         model, args.resource_info, sync=True, parallax_config=config)
     print(f"workers={num_workers} worker_id={worker_id} "
@@ -49,6 +59,12 @@ def main():
     out = sess.run(None, feed_dict=batch)
     print(f"learned w={out['w']:.3f} (true 10.0)  "
           f"b={out['b']:.3f} (true -5.0)")
+    sps = sess.steps_per_sec  # None with obs disabled (PARALLAX_OBS=0)
+    if sps is not None:
+        print(f"steps/sec: {sps:.1f}  "
+              f"(full snapshot: sess.metrics_snapshot())")
+    if args.monitor_health:
+        print("health:", sess.health.report())
     sess.close()
 
 

@@ -61,6 +61,16 @@ def pcast(x, axes, *, to="varying"):
     return x
 
 
+def cost_analysis(compiled) -> dict:
+    """`Compiled.cost_analysis()` normalized across jax releases: older
+    ones return a single-element list of per-program dicts, newer ones
+    the dict itself. Returns {} when the backend reports nothing."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
 def typeof(x):
     """`jax.typeof` (the aval, carrying `.vma` on modern jax); legacy
     fallback returns the plain aval, whose missing `.vma` downstream

@@ -176,6 +176,12 @@ class ParallaxConfig:
     * ``prefetch_depth`` / ``eager_fetch``: async step pipeline knobs
       (no reference analogue — the reference's tf.data input pipeline
       owned this); see the field comments and session.py.
+    * ``trace_path`` / ``metrics_path`` / ``metrics_interval_s`` /
+      ``monitor_health`` / ``log_level`` / ``log_json``: the unified
+      observability layer (obs/) — always-on span tracing + metrics
+      registry + opt-in health monitors; no reference analogue (the
+      reference's only windows were per-step RunMetadata dumps and the
+      Horovod timeline). See the field comments and obs/__init__.py.
     """
 
     run_option: str = consts.RUN_HYBRID
@@ -195,6 +201,39 @@ class ParallaxConfig:
     # the partition search always block regardless, so their wall-times
     # cover real device work.
     eager_fetch: bool = False
+    # -- observability (obs/) --------------------------------------------
+    # Chrome trace-event JSON written at session close: the host-side
+    # span timeline of the dispatch / prefetch / fetch threads, openable
+    # in chrome://tracing or Perfetto. None = no export (spans still
+    # collect into the bounded ring buffer; obs.export_chrome_trace()
+    # can dump it any time). The collector is PROCESS-global — the
+    # export is the one-view timeline of everything the process did
+    # (including other sessions), not a per-session slice.
+    trace_path: Optional[str] = None
+    # Ring-buffer capacity (events) of the span collector; old events
+    # fall off. ~100 bytes/event, so the default is a few MB. Grow-only
+    # against the process-global collector: a later session with a
+    # smaller value never truncates a ring an earlier session sized up.
+    trace_buffer_events: int = 65536
+    # JSONL file appended by a background sink every metrics_interval_s
+    # seconds (plus once at close): one `{"ts": ..., "metrics":
+    # registry.snapshot()}` line per tick, for machine scraping of live
+    # runs. None = no sink (snapshot() is always available in-process).
+    metrics_path: Optional[str] = None
+    metrics_interval_s: float = 10.0
+    # Opt-in per-step health monitoring: the engine appends in-graph
+    # `loss_finite` / `grad_norm` outputs (a few FLOPs next to the
+    # backward pass) and the session consumes them LAZILY — only values
+    # whose D2H transfer already finished are read, so the async
+    # pipeline never blocks on monitoring. Non-finite values warn
+    # immediately and count into the registry (health.*).
+    monitor_health: bool = False
+    # Override the PARALLAX logger level for this run (default: leave
+    # the env-var/import-time level alone). E.g. "DEBUG", "WARNING".
+    log_level: Optional[str] = None
+    # Re-format PARALLAX log lines as one JSON object per line (ts /
+    # level / logger / msg) for machine-scraped runs.
+    log_json: bool = False
     # sync=False only: gradient staleness bound k — each step applies
     # the gradients computed k steps earlier (deterministic SPMD
     # emulation of the reference's async PS, whose staleness was
@@ -230,6 +269,14 @@ class ParallaxConfig:
         if int(self.prefetch_depth) < 1:
             raise ValueError(
                 f"prefetch_depth must be >= 1, got {self.prefetch_depth}")
+        if float(self.metrics_interval_s) <= 0:
+            raise ValueError(
+                f"metrics_interval_s must be > 0, got "
+                f"{self.metrics_interval_s}")
+        if int(self.trace_buffer_events) < 1:
+            raise ValueError(
+                f"trace_buffer_events must be >= 1, got "
+                f"{self.trace_buffer_events}")
 
     # Reference-style setters (kept so ported driver code works unchanged).
     def set_sync(self, sync: bool) -> None:

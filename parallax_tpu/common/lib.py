@@ -45,6 +45,44 @@ if not parallax_log.handlers:
 parallax_log.setLevel(os.environ.get(consts.PARALLAX_LOG_LEVEL, "INFO"))
 
 
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per log line (machine-scraped runs): ts / level /
+    logger / msg, plus the traceback under "exc" when present."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": self.formatTime(record, "%Y-%m-%dT%H:%M:%S"),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+def configure_logging(level=None, json_format: bool = False) -> None:
+    """Re-configure the PARALLAX logger at runtime.
+
+    The import-time level comes from env PARALLAX_LOG_LEVEL — useless to
+    a driver that builds its ``ParallaxConfig`` after import.
+    ``Config(log_level=..., log_json=...)`` routes here at session
+    construction. No-args is a no-op (the env-var behavior stands); both
+    knobs only touch the PARALLAX logger, never the root logger. The
+    logger is process-global, so the change outlives the configuring
+    session — deliberate: logging is a per-process concern (concurrent
+    sessions share the stream), and a close-time restore would flap the
+    format mid-run for whichever session remains.
+    """
+    if level is not None:
+        parallax_log.setLevel(
+            level if isinstance(level, int) else str(level).upper())
+    if json_format:
+        fmt = JsonLogFormatter()
+        for handler in parallax_log.handlers:
+            handler.setFormatter(fmt)
+
+
 # --------------------------------------------------------------------------
 # Resource info
 # --------------------------------------------------------------------------

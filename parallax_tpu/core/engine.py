@@ -52,6 +52,8 @@ from parallax_tpu.common import consts
 from parallax_tpu.common.config import ParallaxConfig
 from parallax_tpu.common.lib import parallax_log
 from parallax_tpu.core import classify, mesh as mesh_lib, specs as specs_lib
+from parallax_tpu.obs import _state as obs_state, \
+    metrics as obs_metrics, trace
 from parallax_tpu.ops import embedding
 
 
@@ -283,10 +285,19 @@ class Engine:
     """Builds and owns the compiled init/step executables for one mesh."""
 
     def __init__(self, model: Model, mesh: Mesh, config: ParallaxConfig,
-                 example_batch):
+                 example_batch,
+                 metrics: Optional[obs_metrics.MetricsRegistry] = None):
         self.model = model
         self.mesh = mesh
         self.config = config
+        # observability (obs/): the owning session passes its registry;
+        # direct Engine construction (tools/, tests) gets a private one
+        self.metrics = metrics if metrics is not None \
+            else obs_metrics.MetricsRegistry()
+        self._recompiles = self.metrics.counter("engine.recompiles")
+        # batch-shape signatures already traced: a growing set means
+        # shape-driven retraces (each one a full XLA compile)
+        self._traced_signatures: set = set()
         if not config.sync:
             parallax_log.info(
                 "sync=False: running bounded-staleness delayed-gradient "
@@ -305,21 +316,27 @@ class Engine:
             parallax_log.info("debug_nans enabled: steps re-run "
                               "op-by-op on NaN and raise at the source")
         rng = jax.random.PRNGKey(0)
-        params_shapes, mstate_shapes = jax.eval_shape(model.call_init, rng)
-        batch_shapes = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(np.shape(x), _dtype_of(x)),
-            example_batch)
-        self._params_shapes = params_shapes
-        self._mstate_shapes = mstate_shapes
-        self._batch_shapes = batch_shapes
-        self.plan = build_plan(model, mesh, config, params_shapes,
-                               batch_shapes, mstate_shapes)
-        self._param_shardings = jax.tree.map(
-            lambda spec: NamedSharding(mesh, spec), self.plan.param_pspecs,
-            is_leaf=lambda x: isinstance(x, P))
-        self.batch_sharding_fn = lambda leaf_ndim: NamedSharding(
-            mesh, mesh_lib.batch_spec(leaf_ndim))
-        self._build()
+        with trace.span("engine.build",
+                        run_option=config.run_option,
+                        num_shards=mesh_lib.num_shards(mesh)):
+            params_shapes, mstate_shapes = jax.eval_shape(model.call_init,
+                                                          rng)
+            batch_shapes = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(np.shape(x), _dtype_of(x)),
+                example_batch)
+            self._params_shapes = params_shapes
+            self._mstate_shapes = mstate_shapes
+            self._batch_shapes = batch_shapes
+            self.plan = build_plan(model, mesh, config, params_shapes,
+                                   batch_shapes, mstate_shapes)
+            self._param_shardings = jax.tree.map(
+                lambda spec: NamedSharding(mesh, spec),
+                self.plan.param_pspecs,
+                is_leaf=lambda x: isinstance(x, P))
+            self.batch_sharding_fn = lambda leaf_ndim: NamedSharding(
+                mesh, mesh_lib.batch_spec(leaf_ndim))
+            self._build()
+        self.metrics.counter("engine.builds").inc()
 
     # -- construction ------------------------------------------------------
 
@@ -609,6 +626,22 @@ class Engine:
                                       slice_state=slice_state)
             outputs = {"loss": loss, "global_step": new_state.step}
             outputs.update(metrics)
+            if config.monitor_health:
+                taken = {"grad_norm", "loss_finite"} & set(metrics)
+                if taken:
+                    # overwriting would silently change what the fetch
+                    # returns based on an unrelated config flag
+                    raise ValueError(
+                        f"monitor_health=True reserves the output names "
+                        f"'grad_norm'/'loss_finite' but the model's "
+                        f"metrics already define {sorted(taken)}; "
+                        f"rename the model metric(s)")
+                # in-graph health signals (obs/health.py): a few FLOPs
+                # next to the backward pass. gdeltas covers the slice
+                # tables' captured row grads, so the norm is global
+                # across both gradient representations.
+                outputs["grad_norm"] = optax.global_norm((grads, gdeltas))
+                outputs["loss_finite"] = jnp.isfinite(loss)
             return new_state, outputs
 
         self._init_jit = jax.jit(init_state)
@@ -618,7 +651,7 @@ class Engine:
     # -- public ops --------------------------------------------------------
 
     def init_state(self, seed: int = 0) -> TrainState:
-        with self.mesh:
+        with trace.span("engine.init_state"), self.mesh:
             return self._init_jit(seed)
 
     def step(self, state: TrainState, batch,
@@ -629,11 +662,50 @@ class Engine:
         thread on a host round trip and re-run feed_transforms)."""
         if not preplaced:
             batch = self.shard_batch(batch)
-        with self.mesh:
+        # signature AFTER placement: both the run() path and the
+        # preplaced run_iter path then see the same (global) array
+        # shapes — the ones _step_jit actually caches on — so mixing
+        # the two paths can't fake a retrace on multi-host
+        self._note_batch_signature(batch)
+        with trace.span("engine.step"), self.mesh:
             new_state, outputs = self._step_jit(state, batch)
         if not self._exported_graph and self.config.export_graph_path:
             self._export_graph(state, batch)
         return new_state, outputs
+
+    def _note_batch_signature(self, batch) -> None:
+        """Flag silent shape-driven retraces: every batch shape/dtype
+        signature beyond the first costs a full XLA recompile of the
+        step — a loop feeding ragged final batches is compile-bound
+        while looking healthy. Counted as ``engine.recompiles`` and
+        warned once per new signature."""
+        if not obs_state.enabled:
+            return
+        try:
+            # fast path: flat dict of arrays (every session feed after
+            # _convert_feed) — the pytree walk below costs ~4x more.
+            # sorted: jit's cache keys on the SORTED flattened pytree,
+            # so insertion order must not fake a retrace
+            sig = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                               for k, v in batch.items()))
+        except AttributeError:
+            sig = tuple(
+                (classify._pathname(kp), tuple(np.shape(leaf)),
+                 str(_dtype_of(leaf)))
+                for kp, leaf in
+                jax.tree_util.tree_flatten_with_path(batch)[0])
+        if sig in self._traced_signatures:
+            return
+        first = not self._traced_signatures
+        self._traced_signatures.add(sig)
+        if not first:
+            self._recompiles.inc()
+            parallax_log.warning(
+                "new batch shape signature #%d triggers an XLA retrace "
+                "of the step (signature: %s); pad batches to a fixed "
+                "shape to avoid recompiles",
+                len(self._traced_signatures) - 1,
+                [(n, s) for n, s, _ in sig])
 
     def close(self):
         """Restore process-global settings this engine changed
@@ -648,6 +720,10 @@ class Engine:
         (the reference's per-replica feed splitting,
         session_context.py:205-233); Model.batch_specs overrides the
         layout per feed name (e.g. sequence-parallel inputs)."""
+        with trace.span("engine.h2d_place"):
+            return self._shard_batch_impl(batch)
+
+    def _shard_batch_impl(self, batch):
         n = mesh_lib.num_devices(self.mesh)
         overrides = self.model.batch_specs
 

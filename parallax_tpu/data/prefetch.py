@@ -28,6 +28,8 @@ import queue
 import threading
 from typing import Any, Callable, Iterable, Iterator, Optional
 
+from parallax_tpu.obs import trace
+
 
 class _End:
     """Queue sentinel: normal exhaustion of the source iterator."""
@@ -69,7 +71,11 @@ class Prefetcher:
                 if self._stop.is_set():
                     return
                 if self._place_fn is not None:
-                    item = self._place_fn(item)
+                    # span: the prefetch thread's slice of the pipeline
+                    # (feed conversion + H2D placement) on the shared
+                    # timeline next to the dispatch thread's spans
+                    with trace.span("prefetch.place"):
+                        item = self._place_fn(item)
                 self._put(item)
                 if self._stop.is_set():
                     return
@@ -107,6 +113,13 @@ class Prefetcher:
                 if self._stop.is_set():
                     self._done = True
                     raise StopIteration from None
+        if self._stop.is_set():
+            # a cross-thread close() raced our get and we won an item:
+            # dropping it is the contract (close = abandon) — yielding
+            # would dispatch a step concurrently with the rest of the
+            # caller's shutdown (checkpoint hook close, engine close)
+            self._done = True
+            raise StopIteration
         if got is _End:
             self._done = True
             raise StopIteration

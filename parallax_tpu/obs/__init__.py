@@ -1,0 +1,38 @@
+"""parallax_tpu.obs — the unified observability layer (ISSUE 2).
+
+Three parts, one import:
+
+  * :mod:`~parallax_tpu.obs.trace` — thread-safe ``span()`` tracing into
+    a ring buffer, exported as Chrome trace-event JSON
+    (``Config(trace_path=...)``): the host-side timeline of the
+    dispatch / prefetch / fetch threads in one `chrome://tracing` view.
+  * :mod:`~parallax_tpu.obs.metrics` — named counters / gauges /
+    histograms behind one ``MetricsRegistry`` with a JSON-ready
+    ``snapshot()`` and a periodic JSONL sink
+    (``Config(metrics_path=..., metrics_interval_s=...)``).
+  * :mod:`~parallax_tpu.obs.health` — opt-in per-step loss-finiteness
+    and grad-global-norm monitoring (``Config(monitor_health=True)``,
+    computed in-graph, fetched lazily), device memory stats, and the
+    engine's recompilation counter.
+
+``disable()`` / ``enable()`` (or env ``PARALLAX_OBS=0``) switch the
+whole layer to near-free no-ops process-wide;
+`tools/check_obs_overhead.py` holds the enabled path to <=2% of step
+wall-time.
+"""
+
+from parallax_tpu.obs._state import disable, enable, is_enabled
+from parallax_tpu.obs import health, metrics, trace
+from parallax_tpu.obs.health import HealthMonitor, device_memory_stats
+from parallax_tpu.obs.metrics import (Counter, Gauge, Histogram,
+                                      JsonlSink, MetricsRegistry,
+                                      PipelineStats)
+from parallax_tpu.obs.trace import (TraceCollector, TraceEvent,
+                                    export_chrome_trace, span)
+
+__all__ = [
+    "trace", "metrics", "health", "span", "TraceCollector", "TraceEvent",
+    "export_chrome_trace", "MetricsRegistry", "Counter", "Gauge",
+    "Histogram", "JsonlSink", "PipelineStats", "HealthMonitor",
+    "device_memory_stats", "enable", "disable", "is_enabled",
+]

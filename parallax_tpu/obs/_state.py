@@ -1,0 +1,34 @@
+"""Process-wide observability kill switch.
+
+One boolean, read per call by every instrument (`trace.span`, counters,
+gauges, histograms): `disable()` turns the whole layer into near-free
+no-ops, which is both the production escape hatch and how
+`tools/check_obs_overhead.py` measures the uninstrumented baseline
+without rebuilding the session. Env ``PARALLAX_OBS=0`` disables at
+import. Disabling stops ALL collection — including the pipeline stats
+behind ``sess.steps_per_sec`` (None while disabled, a value its
+Optional contract always allowed) and ``pipeline_stats.summary()``.
+
+Kept in its own tiny module so `trace` and `metrics` share the flag
+without importing each other.
+"""
+
+from __future__ import annotations
+
+import os
+
+enabled: bool = os.environ.get("PARALLAX_OBS", "1") != "0"
+
+
+def enable() -> None:
+    global enabled
+    enabled = True
+
+
+def disable() -> None:
+    global enabled
+    enabled = False
+
+
+def is_enabled() -> bool:
+    return enabled

@@ -1,0 +1,207 @@
+"""Training health monitors: loss finiteness, gradient norm, device
+memory, recompiles.
+
+The failure modes these catch are the ones that waste a long run
+silently: a loss that went NaN at step 40k (every later step is
+garbage), a gradient norm that exploded (divergence hours before the
+loss shows it), HBM creeping toward OOM, and shape-driven retraces
+(each one a full XLA compile — a "fast" run that recompiles every step
+is compile-bound, not compute-bound).
+
+Loss-finiteness and grad-global-norm are computed **in-graph**
+(core/engine.py appends ``loss_finite`` / ``grad_norm`` outputs when
+``Config(monitor_health=True)``) — a handful of FLOPs next to the
+backward pass — and consumed **lazily** here: ``observe()`` keeps the
+device values and only materializes the ones whose transfers already
+finished (``is_ready``), so the async pipeline's dispatch thread never
+blocks on monitoring. ``report()`` / session close drain the rest.
+
+Everything lands in the session's MetricsRegistry (``health.*``), so
+one snapshot carries it (bench.py, the JSONL sink).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.obs.metrics import MetricsRegistry, summarize_window
+
+
+def device_memory_stats(devices=None) -> Dict[str, Dict[str, int]]:
+    """Per-device memory stats via ``Device.memory_stats()``, keyed
+    ``"<platform>:<id>"``. Backends without the API (CPU) simply don't
+    appear; never raises."""
+    import jax
+    out = {}
+    try:
+        devices = devices if devices is not None else jax.local_devices()
+    except Exception:
+        return out
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            out[f"{d.platform}:{d.id}"] = {
+                k: int(v) for k, v in stats.items()
+                if isinstance(v, (int, float))}
+    return out
+
+
+def _is_ready(value) -> bool:
+    is_ready = getattr(value, "is_ready", None)
+    return bool(is_ready()) if callable(is_ready) else True
+
+
+class HealthMonitor:
+    """Consumes per-step health outputs without blocking dispatch.
+
+    ``observe(step, loss_finite, grad_norm)`` parks the device values in
+    a bounded deque and drains every entry whose transfer has already
+    completed; entries older than ``max_pending`` are drained blocking
+    (bounding host memory — in practice the device is at most a couple
+    of steps behind). A non-finite loss or grad norm increments a
+    counter and logs ONE warning per incident step, immediately — not at
+    the end of the run.
+    """
+
+    def __init__(self, registry: MetricsRegistry, max_pending: int = 128):
+        self._registry = registry
+        self._lock = threading.Lock()
+        # serializes pop+consume as one unit: concurrent pollers (the
+        # dispatch thread and a metrics_snapshot from the sink thread)
+        # must not interleave consumption, or first_nonfinite_step and
+        # the warning order could name the wrong step. observe() only
+        # try-acquires it (skipping the drain under contention), so a
+        # blocking report() can never stall the dispatch thread.
+        self._consume_lock = threading.Lock()
+        self._pending: collections.deque = collections.deque()
+        self._max_pending = int(max_pending)
+        self._observed = registry.counter("health.steps_observed")
+        self._nonfinite_loss = registry.counter(
+            "health.nonfinite_loss_steps")
+        self._nonfinite_grad = registry.counter(
+            "health.nonfinite_grad_steps")
+        self._grad_norm = registry.histogram("health.grad_norm")
+        self._last_grad_norm = registry.gauge("health.last_grad_norm")
+        # report()/healthy bookkeeping is plain ints, NOT the registry
+        # counters: monitor_health=True is an explicit opt-in that must
+        # stay self-consistent even with the obs layer disabled
+        # (PARALLAX_OBS=0 makes Counter.inc a no-op, which would report
+        # 0 nonfinite steps next to a set first_nonfinite_step).
+        # Written only from _consume, which _consume_lock serializes.
+        self._n_observed = 0
+        self._n_nonfinite_loss = 0
+        self._n_nonfinite_grad = 0
+        # own grad-norm window for the same reason (the registry
+        # histogram no-ops when obs is disabled, but the opt-in report
+        # must still carry the trend the user is paying in-graph for)
+        self._norms: collections.deque = collections.deque(maxlen=512)
+        self._n_norms = 0
+        self.first_nonfinite_step: Optional[int] = None
+
+    # -- producer side (dispatch thread) -----------------------------------
+
+    def observe(self, step: int, loss_finite=None,
+                grad_norm=None) -> None:
+        """Queue one step's health outputs (device values ok); drains
+        whatever is ready, never blocking on in-flight steps unless the
+        backlog exceeds ``max_pending``."""
+        with self._lock:
+            self._pending.append((step, loss_finite, grad_norm))
+        # opportunistic drain: if another thread (report()/snapshot
+        # poll) holds the consume lock, skip rather than wait — the
+        # dispatch thread must never stall behind a blocking drain
+        if self._consume_lock.acquire(blocking=False):
+            try:
+                self._poll_locked(block=False)
+            finally:
+                self._consume_lock.release()
+        # bound the backlog by draining ONLY the oldest entries past the
+        # cap — never the whole queue, which would block dispatch on the
+        # just-dispatched step and collapse the async pipeline. The size
+        # check happens OUTSIDE the consume lock: under the cap (the
+        # steady state) observe must not wait on a concurrent blocking
+        # report() drain.
+        while True:
+            with self._lock:
+                over = len(self._pending) > self._max_pending
+            if not over:
+                break
+            with self._consume_lock:
+                with self._lock:
+                    if len(self._pending) <= self._max_pending:
+                        break
+                    entry = self._pending.popleft()
+                self._consume(*entry)
+
+    # -- consumer side -----------------------------------------------------
+
+    def poll(self, block: bool = False) -> int:
+        """Materialize queued entries — in order, stopping at the first
+        not-yet-ready one unless ``block``. Returns entries consumed."""
+        with self._consume_lock:
+            return self._poll_locked(block)
+
+    def _poll_locked(self, block: bool) -> int:
+        consumed = 0
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return consumed
+                step, lf, gn = self._pending[0]
+                if not block and not (_is_ready(lf) and _is_ready(gn)):
+                    return consumed
+                self._pending.popleft()
+            self._consume(step, lf, gn)
+            consumed += 1
+
+    def _consume(self, step: int, loss_finite, grad_norm) -> None:
+        self._n_observed += 1
+        self._observed.inc()
+        if loss_finite is not None:
+            finite = bool(np.asarray(loss_finite))
+            if not finite:
+                self._n_nonfinite_loss += 1
+                self._nonfinite_loss.inc()
+                if self.first_nonfinite_step is None:
+                    self.first_nonfinite_step = step
+                parallax_log.warning(
+                    "health: loss is non-finite at step %d", step)
+        if grad_norm is not None:
+            norm = float(np.asarray(grad_norm))
+            if np.isfinite(norm):
+                self._norms.append(norm)
+                self._n_norms += 1
+                self._grad_norm.record(norm)
+                self._last_grad_norm.set(norm)
+            else:
+                self._n_nonfinite_grad += 1
+                self._nonfinite_grad.inc()
+                parallax_log.warning(
+                    "health: gradient global norm is non-finite at "
+                    "step %d", step)
+
+    def report(self) -> Dict:
+        """Drain everything (blocking) and return the health summary."""
+        self.poll(block=True)
+        return {
+            "steps_observed": self._n_observed,
+            "nonfinite_loss_steps": self._n_nonfinite_loss,
+            "nonfinite_grad_steps": self._n_nonfinite_grad,
+            "first_nonfinite_step": self.first_nonfinite_step,
+            "grad_norm": summarize_window(sorted(self._norms),
+                                          self._n_norms),
+        }
+
+    @property
+    def healthy(self) -> bool:
+        """False once any non-finite loss/grad has been seen."""
+        return (self._n_nonfinite_loss == 0
+                and self._n_nonfinite_grad == 0)

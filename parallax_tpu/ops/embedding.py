@@ -436,10 +436,18 @@ def _sharded_lookup(table, ids, mesh, dedup_capacity: Optional[int] = None,
 
     if over is None:
         over = jnp.zeros((), jnp.bool_)  # unused placeholder
+    # The guarded-capacity cond mixes a branch whose collectives the
+    # replication checker can infer (raw) with one it can't see through
+    # (dedup's unique+take), and some jax releases reject the branch
+    # pair as "mismatched replication types". The checker is purely
+    # static — disabling it for exactly this case changes no numerics;
+    # out_specs still declares the true layout.
+    check = not (guarded and dedup_capacity is not None)
     return compat.shard_map(
         local, mesh=mesh,
         in_specs=(P(AXIS_SHARD, None), P((AXIS_REPL, AXIS_SHARD)), P()),
         out_specs=P((AXIS_REPL, AXIS_SHARD)),
+        check_vma=check,
     )(table, ids.reshape(ids_shape), over)
 
 

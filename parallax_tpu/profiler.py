@@ -13,87 +13,18 @@ limit; we keep it so a pod doesn't write N identical traces).
 
 from __future__ import annotations
 
-import collections
 import os
 import socket
-import threading
-from typing import Dict, Optional
+from typing import Optional
 
 import jax
 
 from parallax_tpu.common.config import ProfileConfig
 from parallax_tpu.common.lib import parallax_log
-
-
-class PipelineStats:
-    """Rolling per-step observability for the async step pipeline.
-
-    Three signals, each answering one overlap question (ISSUE 1 —
-    without them a prefetch regression is invisible until someone
-    re-profiles):
-
-    * **dispatch gap** — host-side idle between the end of one
-      ``run()`` dispatch and the start of the next. This is the bubble
-      the prefetcher exists to close: near-zero means batch *t+1* was
-      ready when step *t* was dispatched.
-    * **H2D bytes** — feed bytes placed per step (the traffic the
-      double-buffered transfer hides).
-    * **blocked-on-device** — host time spent inside fetch
-      materialization (``Fetch.result`` / eager ``np.asarray``) waiting
-      for the device. High values with a low gap mean the pipeline is
-      device-bound (good); high values AND a high gap mean fetches are
-      serializing dispatch (the pre-async pathology).
-
-    Writers (the dispatch thread and the prefetch thread) and the
-    ``summary()`` snapshot all synchronize on one lock, so summary()
-    may be polled from a monitoring loop while a pipeline is live.
-    """
-
-    def __init__(self, window: int = 200):
-        self._lock = threading.Lock()
-        self._gaps = collections.deque(maxlen=window)
-        self._dispatch = collections.deque(maxlen=window)
-        self._h2d = collections.deque(maxlen=window)
-        self._blocked = collections.deque(maxlen=window)
-        self._steps = 0
-
-    def record_dispatch(self, gap_s: Optional[float],
-                        dispatch_s: float) -> None:
-        with self._lock:
-            if gap_s is not None:
-                self._gaps.append(gap_s)
-            self._dispatch.append(dispatch_s)
-            self._steps += 1
-
-    def record_h2d(self, nbytes: int) -> None:
-        with self._lock:
-            self._h2d.append(int(nbytes))
-
-    def record_blocked(self, seconds: float) -> None:
-        with self._lock:
-            self._blocked.append(seconds)
-
-    @staticmethod
-    def _ms(vals) -> Optional[Dict[str, float]]:
-        if not vals:
-            return None
-        v = list(vals)
-        return {"mean_ms": round(sum(v) / len(v) * 1e3, 3),
-                "max_ms": round(max(v) * 1e3, 3)}
-
-    def summary(self) -> Dict:
-        """Snapshot over the rolling window, JSON-ready (bench.py)."""
-        with self._lock:
-            h2d = list(self._h2d)
-            out = {
-                "steps": self._steps,
-                "dispatch_gap": self._ms(self._gaps),
-                "dispatch": self._ms(self._dispatch),
-                "blocked_on_device": self._ms(self._blocked),
-                "h2d_bytes_per_step": (round(sum(h2d) / len(h2d))
-                                       if h2d else None),
-            }
-        return out
+# PipelineStats migrated onto the metrics registry (ISSUE 2); re-export
+# kept so `from parallax_tpu.profiler import PipelineStats` call sites
+# survive the move.
+from parallax_tpu.obs.metrics import PipelineStats  # noqa: F401
 
 
 class ProfileHook:
@@ -155,3 +86,24 @@ class ProfileHook:
         if not self._is_profile_step(step + 1):
             jax.profiler.stop_trace()
             self._tracing = False
+
+    def close(self) -> None:
+        """Stop an in-flight trace. A profile_range extending past the
+        last training step otherwise leaves jax.profiler recording
+        forever — the trace directory ends up unterminated/unreadable
+        and a later start_trace raises. Called by
+        ParallaxSession.close(); idempotent."""
+        if not self._tracing:
+            return
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # never let profiler teardown mask close
+            parallax_log.warning("stopping in-flight trace failed: %s", e)
+        else:
+            parallax_log.info(
+                "stopped in-flight profiler trace at session close (the "
+                "configured profile range extended past the last step)")
+        # cleared even on failure: retrying a stop that just raised
+        # can't succeed, and the flag must not wedge close() into
+        # repeating it
+        self._tracing = False

@@ -39,6 +39,7 @@ we re-jit and reshard in place).
 from __future__ import annotations
 
 import operator
+import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
 
@@ -47,10 +48,14 @@ import numpy as np
 
 from parallax_tpu.common import consts
 from parallax_tpu.common.config import ParallaxConfig
-from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.common.lib import configure_logging, parallax_log
 from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
 from parallax_tpu.checkpoint import CheckpointHook
-from parallax_tpu.profiler import PipelineStats, ProfileHook
+from parallax_tpu.obs import trace
+from parallax_tpu.obs.health import HealthMonitor, device_memory_stats
+from parallax_tpu.obs.metrics import (JsonlSink, MetricsRegistry,
+                                      PipelineStats)
+from parallax_tpu.profiler import ProfileHook
 from parallax_tpu.parallel.partitions import PartitionSearch
 
 
@@ -87,7 +92,8 @@ class Fetch:
         return the host value; cached after the first call."""
         if not self._done:
             t0 = time.perf_counter()
-            host = _to_host(self._raw)
+            with trace.span("fetch.block"):
+                host = _to_host(self._raw)
             if self._on_block is not None:
                 self._on_block(time.perf_counter() - t0)
             self._host = host
@@ -244,6 +250,7 @@ class ParallaxSession:
         self._num_partitions = num_partitions
         self._engine: Optional[engine_lib.Engine] = None
         self._state = None
+        self._build_lock = threading.Lock()
         self._search = partition_search
         self._step_times: List[float] = []
         self._ckpt = CheckpointHook(config.ckpt_config, worker_id)
@@ -252,30 +259,54 @@ class ParallaxSession:
         # Host-side mirror of state.step: reading the device value every
         # run() would block on the previous step and kill async dispatch.
         self._host_step = 0
-        from collections import deque
-        self._recent_times = deque(maxlen=20)
-        # async pipeline state
-        self.pipeline_stats = PipelineStats()
+        # -- observability (obs/): one registry for the whole runtime --
+        configure_logging(config.log_level, config.log_json)
+        # grow-only: the collector is process-global, and a later
+        # default-config session must not truncate the ring an earlier
+        # session sized up for a long capture
+        if config.trace_buffer_events > trace.get_collector().capacity:
+            trace.get_collector().set_capacity(config.trace_buffer_events)
+        self.metrics = MetricsRegistry()
+        # async pipeline stats flow through the registry (pipeline.*)
+        self.pipeline_stats = PipelineStats(self.metrics)
+        self.health = (HealthMonitor(self.metrics)
+                       if config.monitor_health else None)
+        self._metrics_sink = (
+            JsonlSink(self.metrics, config.metrics_path,
+                      config.metrics_interval_s,
+                      snapshot_fn=self.metrics_snapshot)
+            if config.metrics_path else None)
         self._last_dispatch_end: Optional[float] = None
         self._prefetcher = None
 
     # -- lazy build (needs the first batch to know shapes) ----------------
 
     def _ensure_engine(self, batch):
-        if self._engine is not None:
-            return
-        self._build_engine(batch, self._num_partitions)
-        restored = self._ckpt.restore(self._state)
-        if restored is not None:
-            self._state = restored
-            parallax_log.info("restored checkpoint at step %d",
-                              int(self._state.step))
-        self._host_step = int(self._state.step)
+        # serialized: place_batch is documented safe from a background
+        # thread ("builds the engine on first use"), so its first call
+        # can race a foreground run()'s — without the lock both would
+        # build (state could initialize on one engine's mesh while
+        # self._engine ends up the other), or a thread could proceed on
+        # pre-restore state. Always locking keeps the built path honest
+        # too; uncontended acquisition is ~µs against a ms-scale step.
+        with self._build_lock:
+            if self._engine is not None:
+                return
+            self._build_engine(batch, self._num_partitions)
+            # restore inside the lock: the losing thread must not see
+            # the engine and run on pre-restore state
+            restored = self._ckpt.restore(self._state)
+            if restored is not None:
+                self._state = restored
+                parallax_log.info("restored checkpoint at step %d",
+                                  int(self._state.step))
+            self._host_step = int(self._state.step)
 
     def _build_engine(self, example_batch, num_partitions):
         mesh = mesh_lib.build_mesh(num_partitions=num_partitions)
         self._engine = engine_lib.Engine(self._model, mesh, self._config,
-                                         example_batch)
+                                         example_batch,
+                                         metrics=self.metrics)
         if self._state is None:
             self._state = self._engine.init_state(self._seed)
         else:
@@ -441,30 +472,39 @@ class ParallaxSession:
         t0 = time.perf_counter()
         gap = (None if self._last_dispatch_end is None
                else t0 - self._last_dispatch_end)
-        if not placed:
-            self.pipeline_stats.record_h2d(_feed_nbytes(batch))
-        self._state, outputs = self._engine.step(self._state, batch,
-                                                 preplaced=placed)
-        # debug_nans blocks too: its contract is "raise at the step that
-        # produced the NaN", which lazy fetches would defer to whatever
-        # later line first reads a value
-        blocking = (self._search is not None or self._profile.active
-                    or self._config.debug_nans
-                    or (self._config.eager_fetch and not force_lazy))
-        if blocking:
-            # Block so step timing / traces cover real device work.
-            tb = time.perf_counter()
-            outputs = {k: np.asarray(v) for k, v in outputs.items()}
-            self.pipeline_stats.record_blocked(time.perf_counter() - tb)
+        with trace.span("session.dispatch", step=step):
+            if not placed:
+                self.pipeline_stats.record_h2d(_feed_nbytes(batch))
+            self._state, outputs = self._engine.step(self._state, batch,
+                                                     preplaced=placed)
+            # debug_nans blocks too: its contract is "raise at the step
+            # that produced the NaN", which lazy fetches would defer to
+            # whatever later line first reads a value
+            blocking = (self._search is not None or self._profile.active
+                        or self._config.debug_nans
+                        or (self._config.eager_fetch and not force_lazy))
+            if blocking:
+                # Block so step timing / traces cover real device work.
+                tb = time.perf_counter()
+                outputs = {k: np.asarray(v) for k, v in outputs.items()}
+                self.pipeline_stats.record_blocked(
+                    time.perf_counter() - tb)
         now = time.perf_counter()
         dt = now - t0
         self._last_dispatch_end = now
         self.pipeline_stats.record_dispatch(gap, dt)
         self._profile.after_step(step)
         self._last_outputs = outputs
-        self._recent_times.append(now)
         new_step = step + 1
         self._host_step = new_step
+        if self.health is not None:
+            # lazy: only already-transferred values are read, so the
+            # dispatch thread never blocks on monitoring. `step` (the
+            # pre-increment index) matches the session.dispatch span and
+            # ProfileHook numbering, so a NaN warning cross-references
+            # the trace/profile of the step that produced it.
+            self.health.observe(step, outputs.get("loss_finite"),
+                                outputs.get("grad_norm"))
         if self._ckpt.maybe_save(new_step, self._state):
             self._warn_sparse_overflow("checkpoint")
         if self._search is not None:
@@ -492,12 +532,37 @@ class ParallaxSession:
     @property
     def steps_per_sec(self) -> Optional[float]:
         """Rolling dispatch throughput over the last <=20 steps (the
-        framework-side metric the reference left to user drivers)."""
-        if len(self._recent_times) < 2:
-            return None
-        window = list(self._recent_times)
-        dt = window[-1] - window[0]
-        return (len(window) - 1) / dt if dt > 0 else None
+        framework-side metric the reference left to user drivers);
+        lives in the registry as the ``pipeline.steps_per_sec`` gauge."""
+        return self.pipeline_stats.steps_per_sec()
+
+    def metrics_snapshot(self) -> Dict:
+        """One JSON-ready dict of every runtime metric — pipeline
+        overlap (dispatch gap / H2D bytes / blocked-on-device /
+        steps-per-sec), engine builds + recompiles, health counters when
+        enabled — with the polled gauges (sparse overflow, device
+        memory) refreshed first. Safe to call from a monitoring thread
+        while training is live (bench.py stamps this into BENCH JSON)."""
+        try:
+            self.metrics.gauge("sparse.overflow_steps").set(
+                self.sparse_overflow_steps())
+        except Exception:
+            # reading live opt_state can race step donation; the stale
+            # gauge value is better than killing a monitoring thread
+            pass
+        for dev, stats in device_memory_stats().items():
+            for key in ("bytes_in_use", "peak_bytes_in_use"):
+                if key in stats:
+                    self.metrics.gauge(f"memory.{dev}.{key}").set(
+                        stats[key])
+        if self.health is not None:
+            try:
+                self.health.poll()
+            except Exception:
+                # same class of live-state race as the overflow gauge
+                # above: a poisoned buffer must not kill the caller
+                pass
+        return self.metrics.snapshot()
 
     # -- partition search (reference: common/partitions.py) ---------------
 
@@ -531,12 +596,17 @@ class ParallaxSession:
             self._build_engine_from_live(nxt)
 
     def _build_engine_from_live(self, p: int) -> None:
-        example = self._last_example_batch
-        self._build_engine(example, p)
+        with trace.span("partition.replan", num_partitions=p):
+            example = self._last_example_batch
+            self._build_engine(example, p)
 
     # -- feed/fetch conversion (session_context.py:179-233 parity) --------
 
     def _convert_feed(self, feed_dict):
+        with trace.span("session.convert_feed"):
+            return self._convert_feed_impl(feed_dict)
+
+    def _convert_feed_impl(self, feed_dict):
         batch = {}
         for name, value in feed_dict.items():
             if isinstance(value, (list, tuple)):
@@ -583,11 +653,52 @@ class ParallaxSession:
                 "Raise max_touched_rows.", n, where)
 
     def close(self):
+        # Each teardown step is isolated: a failure in one (a poisoned
+        # device buffer surfacing in the overflow read or the health
+        # drain, a failed async checkpoint commit raising from the
+        # orbax close) must not skip the rest — the sink thread would
+        # run forever, an in-flight profiler trace would record
+        # forever, the configured chrome trace would never land, and
+        # engine.close() restores process-global jax settings later
+        # sessions depend on.
         if self._prefetcher is not None:
             self._prefetcher.close()
             self._prefetcher = None
-        self._warn_sparse_overflow("close")
-        self._ckpt.close()
+        try:
+            self._warn_sparse_overflow("close")
+        except Exception as e:  # reads live opt_state: can race donation
+            parallax_log.warning("sparse-overflow check failed: %s", e)
+        try:
+            self._ckpt.close()
+        except Exception as e:  # e.g. a pending async save that failed
+            parallax_log.warning("checkpoint close failed: %s", e)
+        try:
+            # stop an in-flight jax.profiler trace (a profile_range past
+            # the last step would otherwise record forever)
+            self._profile.close()
+        except Exception as e:
+            parallax_log.warning("profile close failed: %s", e)
+        if self.health is not None:
+            try:
+                # drain every still-pending device value (blocking is
+                # fine at close) so the report covers the whole run
+                report = self.health.report()
+                if not self.health.healthy:
+                    parallax_log.warning("health at close: %s", report)
+            except Exception as e:
+                parallax_log.warning("health drain failed: %s", e)
+        if self._metrics_sink is not None:
+            try:
+                self._metrics_sink.stop()  # writes the final JSONL line
+            except Exception as e:
+                parallax_log.warning("metrics sink stop failed: %s", e)
+            self._metrics_sink = None
+        if self._config.trace_path:
+            try:
+                path = trace.export_chrome_trace(self._config.trace_path)
+                parallax_log.info("wrote chrome trace to %s", path)
+            except Exception as e:  # e.g. unwritable path
+                parallax_log.warning("chrome trace export failed: %s", e)
         if self._engine is not None:
             self._engine.close()
 

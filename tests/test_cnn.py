@@ -66,10 +66,15 @@ def test_lenet_trains_and_updates_batch_stats(rng):
 
     batches = [learnable_batch() for _ in range(2)]
     losses = []
-    for i in range(50):
+    for i in range(120):
         loss = sess.run("loss", feed_dict=batches[i % 2])
-        losses.append(loss)
-    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        losses.append(float(loss))
+    # alternating two batches under plain SGD oscillates per step and
+    # the trajectory speed is init/toolchain-dependent (50 steps sat
+    # exactly on the 0.5x boundary on some jax builds), so judge a late
+    # WINDOW, not one endpoint
+    assert np.mean(losses[-20:]) < losses[0] * 0.5, (
+        losses[0], losses[-20:])
     sess.close()
 
 

@@ -1,0 +1,593 @@
+"""Unified observability layer (ISSUE 2): span tracing (ring buffer,
+chrome export, cross-thread nesting), metrics registry (thread safety,
+snapshot/JSONL sink), health monitors (injected NaN detection, lazy
+consumption), ProfileHook.close, logging config, recompile counter, and
+the <=2% instrumentation-overhead budget."""
+
+import json
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import parallax_tpu as parallax
+from parallax_tpu import obs
+from parallax_tpu.common.lib import (JsonLogFormatter, configure_logging,
+                                     parallax_log)
+from parallax_tpu.data.prefetch import Prefetcher
+from parallax_tpu.models import simple
+from parallax_tpu.obs import trace
+from parallax_tpu.obs.health import HealthMonitor
+from parallax_tpu.obs.metrics import (JsonlSink, MetricsRegistry,
+                                      PipelineStats)
+
+
+def _simple_session(**cfg_kw):
+    sess, *_ = parallax.parallel_run(
+        simple.build_model(learning_rate=0.1),
+        parallax_config=parallax.Config(run_option="AR",
+                                        search_partitions=False,
+                                        **cfg_kw))
+    return sess
+
+
+def _batches(n, batch=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return [simple.make_batch(rng, batch) for _ in range(n)]
+
+
+# -- metrics registry ------------------------------------------------------
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_basics(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(4)
+        assert reg.counter("c").value == 5
+        reg.gauge("g").set(2.5)
+        assert reg.gauge("g").value == 2.5
+        reg.gauge("gfn").set_fn(lambda: 7)
+        h = reg.histogram("h")
+        for v in [1.0, 2.0, 3.0, 4.0, 100.0]:
+            h.record(v)
+        snap = reg.snapshot()
+        assert snap["c"] == 5 and snap["g"] == 2.5 and snap["gfn"] == 7
+        assert snap["h"]["count"] == 5
+        assert snap["h"]["max"] == 100.0
+        assert snap["h"]["mean"] == pytest.approx(22.0)
+        assert snap["h"]["p50"] == 3.0
+        # JSON-ready end to end
+        json.loads(json.dumps(snap))
+
+    def test_get_or_create_type_conflict(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        assert reg.counter("x") is reg.counter("x")
+        with pytest.raises(TypeError, match="already registered"):
+            reg.gauge("x")
+
+    def test_thread_safety_under_concurrent_writers(self):
+        """8 writer threads hammer one counter + one histogram; every
+        increment/sample must land (lost updates would silently corrupt
+        pipeline stats written from the dispatch AND prefetch threads)."""
+        reg = MetricsRegistry()
+        c = reg.counter("hits")
+        n_threads, n_iter = 8, 5000
+        # window >= total samples: the windowed mean then covers every
+        # record, so a lost cross-thread sample shows up exactly
+        h = reg.histogram("vals", window=n_threads * n_iter)
+        start = threading.Barrier(n_threads)
+
+        def writer(tid):
+            start.wait()
+            for i in range(n_iter):
+                c.inc()
+                h.record(float(tid))
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == n_threads * n_iter
+        snap = h.snapshot()
+        assert snap["count"] == n_threads * n_iter
+        # mean of tids 0..7 uniformly = 3.5
+        assert snap["mean"] == pytest.approx(3.5, abs=0.01)
+
+    def test_histogram_stats_follow_the_rolling_window(self):
+        """mean/p50/p95/max describe the recent window (regressions
+        must not be diluted by old samples; the step-0 compile must not
+        pin max forever); only count is lifetime."""
+        reg = MetricsRegistry()
+        h = reg.histogram("h", window=10)
+        h.record(1e6)  # the 'compile spike', long since evicted
+        for v in range(1000):
+            h.record(float(v))
+        snap = h.snapshot()
+        assert snap["count"] == 1001          # lifetime
+        assert snap["max"] == 999.0           # window, not the spike
+        assert snap["p50"] >= 990.0           # window = recent values
+        assert snap["mean"] == pytest.approx(994.5)  # mean(990..999)
+
+    def test_disabled_layer_is_noop(self):
+        reg = MetricsRegistry()
+        obs.disable()
+        try:
+            reg.counter("c").inc()
+            reg.histogram("h").record(1.0)
+            reg.gauge("g").set(3)
+        finally:
+            obs.enable()
+        snap = reg.snapshot()
+        assert snap["c"] == 0 and snap["h"] is None and snap["g"] is None
+
+    def test_jsonl_sink_writes_parseable_lines(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("n").inc(3)
+        path = tmp_path / "metrics.jsonl"
+        sink = JsonlSink(reg, str(path), interval_s=0.05)
+        time.sleep(0.18)
+        sink.stop()
+        sink.stop()  # idempotent
+        lines = [json.loads(line) for line in
+                 path.read_text().splitlines()]
+        assert len(lines) >= 2  # periodic + final flush
+        assert all(line["metrics"]["n"] == 3 for line in lines)
+        assert all("ts" in line for line in lines)
+
+
+# -- span tracing ----------------------------------------------------------
+
+
+class TestTrace:
+    def test_span_records_name_duration_args(self):
+        col = trace.TraceCollector(capacity=128)
+        prev = trace.set_collector(col)
+        try:
+            with trace.span("work", step=3):
+                time.sleep(0.002)
+        finally:
+            trace.set_collector(prev)
+        (ev,) = col.events()
+        assert ev.name == "work"
+        assert ev.dur >= 0.002
+        assert ev.args == {"step": 3}
+        assert ev.tid == threading.get_ident()
+
+    def test_nesting_same_thread_interval_containment(self):
+        col = trace.TraceCollector(capacity=128)
+        prev = trace.set_collector(col)
+        try:
+            with trace.span("outer"):
+                with trace.span("inner"):
+                    pass
+        finally:
+            trace.set_collector(prev)
+        by_name = {e.name: e for e in col.events()}
+        o, i = by_name["outer"], by_name["inner"]
+        assert o.tid == i.tid
+        # chrome nests complete events by containment: inner ⊂ outer
+        assert o.ts <= i.ts
+        assert i.ts + i.dur <= o.ts + o.dur + 1e-9
+
+    def test_span_nesting_across_prefetch_thread(self):
+        """Spans opened on the prefetch thread land in the same
+        collector with their own tid — the one-view timeline the chrome
+        export promises."""
+        col = trace.TraceCollector(capacity=256)
+        prev = trace.set_collector(col)
+        try:
+            def place(x):
+                with trace.span("inner.place", item=x):
+                    return x * 2
+            with trace.span("consume.all"):
+                with Prefetcher(range(6), place, depth=2) as pf:
+                    assert list(pf) == [2 * i for i in range(6)]
+        finally:
+            trace.set_collector(prev)
+        events = col.events()
+        tids = {e.tid for e in events}
+        assert len(tids) == 2  # dispatch thread + prefetch thread
+        prefetch_tids = {e.tid for e in events
+                         if e.name in ("inner.place", "prefetch.place")}
+        assert threading.get_ident() not in prefetch_tids
+        # the generic prefetch.place span wraps the user place_fn: its
+        # inner.place must nest inside it on the prefetch thread
+        wraps = [e for e in events if e.name == "prefetch.place"]
+        inners = [e for e in events if e.name == "inner.place"]
+        assert len(wraps) == len(inners) == 6
+        for w, i in zip(sorted(wraps, key=lambda e: e.ts),
+                        sorted(inners, key=lambda e: e.ts)):
+            assert w.ts <= i.ts and i.ts + i.dur <= w.ts + w.dur + 1e-9
+
+    def test_ring_buffer_bounds_and_dropped(self):
+        col = trace.TraceCollector(capacity=16)
+        prev = trace.set_collector(col)
+        try:
+            for i in range(50):
+                with trace.span(f"s{i}"):
+                    pass
+        finally:
+            trace.set_collector(prev)
+        events = col.events()
+        assert len(events) == 16
+        assert events[-1].name == "s49"  # most recent kept
+        assert col.dropped == 34
+
+    def test_exception_flagged_and_propagates(self):
+        col = trace.TraceCollector(capacity=8)
+        prev = trace.set_collector(col)
+        try:
+            with pytest.raises(ValueError, match="boom"):
+                with trace.span("fails"):
+                    raise ValueError("boom")
+        finally:
+            trace.set_collector(prev)
+        (ev,) = col.events()
+        assert ev.args["error"] == "ValueError"
+
+    def test_chrome_export_roundtrips_json(self, tmp_path):
+        col = trace.TraceCollector(capacity=64)
+        prev = trace.set_collector(col)
+        try:
+            with trace.span("a", k="v"):
+                with trace.span("b"):
+                    pass
+        finally:
+            trace.set_collector(prev)
+        path = tmp_path / "sub" / "trace.json"  # exercises makedirs
+        col.export_chrome_trace(str(path))
+        doc = json.loads(path.read_text())
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert {e["name"] for e in xs} == {"a", "b"}
+        assert all({"pid", "tid", "ts", "dur"} <= set(e) for e in xs)
+        assert metas and metas[0]["name"] == "thread_name"
+        a = next(e for e in xs if e["name"] == "a")
+        assert a["args"] == {"k": "v"}
+
+    def test_disabled_span_is_noop(self):
+        col = trace.TraceCollector(capacity=8)
+        prev = trace.set_collector(col)
+        try:
+            obs.disable()
+            with trace.span("ghost"):
+                pass
+        finally:
+            obs.enable()
+            trace.set_collector(prev)
+        assert col.events() == []
+
+
+# -- pipeline stats on the registry ----------------------------------------
+
+
+class TestPipelineStatsMigration:
+    def test_summary_shape_and_registry_names(self):
+        reg = MetricsRegistry()
+        ps = PipelineStats(reg)
+        ps.record_dispatch(None, 0.002)
+        ps.record_dispatch(0.001, 0.002)
+        ps.record_h2d(4096)
+        ps.record_blocked(0.0005)
+        s = ps.summary()
+        assert s["steps"] == 2
+        assert s["dispatch_gap"]["mean_ms"] == pytest.approx(1.0)
+        assert s["dispatch"]["max_ms"] == pytest.approx(2.0)
+        assert s["blocked_on_device"]["mean_ms"] == pytest.approx(0.5)
+        assert s["h2d_bytes_per_step"] == 4096
+        snap = reg.snapshot()
+        assert snap["pipeline.steps"] == 2
+        assert snap["pipeline.dispatch_ms"]["count"] == 2
+        assert snap["pipeline.h2d_bytes"]["p50"] == 4096
+        assert "pipeline.steps_per_sec" in snap
+
+    def test_steps_per_sec_gauge(self):
+        reg = MetricsRegistry()
+        ps = PipelineStats(reg)
+        for _ in range(5):
+            ps.record_dispatch(None, 0.001)
+            time.sleep(0.002)
+        sps = reg.snapshot()["pipeline.steps_per_sec"]
+        assert sps is not None and 0 < sps < 1000
+
+
+# -- health monitors -------------------------------------------------------
+
+
+class TestHealthMonitor:
+    def test_detects_injected_nan_loss(self):
+        reg = MetricsRegistry()
+        hm = HealthMonitor(reg)
+        hm.observe(1, np.bool_(True), np.float32(1.5))
+        hm.observe(2, np.bool_(False), np.float32(np.nan))  # NaN step
+        hm.observe(3, np.bool_(True), np.float32(2.0))
+        report = hm.report()
+        assert report["steps_observed"] == 3
+        assert report["nonfinite_loss_steps"] == 1
+        assert report["nonfinite_grad_steps"] == 1
+        assert report["first_nonfinite_step"] == 2
+        assert report["grad_norm"]["count"] == 2  # NaN norm excluded
+        assert not hm.healthy
+
+    def test_lazy_consumption_defers_until_ready(self):
+        class SlowValue:
+            """Device-value stand-in whose transfer 'finishes' later."""
+            def __init__(self, v):
+                self._v = v
+                self.ready = False
+            def is_ready(self):
+                return self.ready
+            def __array__(self, dtype=None, copy=None):
+                assert self.ready, "materialized before ready"
+                return np.asarray(self._v, dtype=dtype)
+
+        reg = MetricsRegistry()
+        hm = HealthMonitor(reg)
+        slow = SlowValue(True)
+        hm.observe(1, slow, None)     # not ready: must stay queued
+        assert reg.counter("health.steps_observed").value == 0
+        slow.ready = True
+        hm.poll()
+        assert reg.counter("health.steps_observed").value == 1
+
+    def test_session_detects_nan_loss_end_to_end(self):
+        """Injected NaN batch through a real session with
+        monitor_health=True: the registry counts the non-finite step."""
+        sess = _simple_session(monitor_health=True)
+        try:
+            good = _batches(3)
+            bad = _batches(1, seed=9)[0]
+            bad["x"] = np.full_like(bad["x"], np.nan)
+            for b in (good[0], good[1], bad, good[2]):
+                sess.run("loss", feed_dict=b)
+            report = sess.health.report()
+            assert report["nonfinite_loss_steps"] >= 1
+            # 0-based dispatch index, same numbering as the
+            # session.dispatch trace span and ProfileHook
+            assert report["first_nonfinite_step"] == 2
+            assert not sess.health.healthy
+            assert sess.metrics_snapshot()[
+                "health.nonfinite_loss_steps"] >= 1
+        finally:
+            sess.close()
+
+    def test_health_outputs_present_and_finite_when_enabled(self):
+        sess = _simple_session(monitor_health=True)
+        try:
+            out = parallax.materialize(
+                sess.run(None, feed_dict=_batches(1)[0]))
+            assert out["loss_finite"]
+            assert np.isfinite(out["grad_norm"]) and out["grad_norm"] > 0
+            # off by default: no extra outputs, no monitor
+            sess2 = _simple_session()
+            try:
+                out2 = sess2.run(None, feed_dict=_batches(1)[0])
+                assert "grad_norm" not in out2
+                assert sess2.health is None
+            finally:
+                sess2.close()
+        finally:
+            sess.close()
+
+
+# -- session integration ---------------------------------------------------
+
+
+class TestSessionObservability:
+    def test_trace_path_written_at_close_with_both_threads(self,
+                                                           tmp_path):
+        """Acceptance: Config(trace_path=...) writes a valid chrome
+        trace containing spans from the dispatch AND prefetch threads."""
+        path = tmp_path / "trace.json"
+        sess = _simple_session(trace_path=str(path))
+        trace.get_collector().clear()  # isolate from other tests
+        try:
+            for _ in sess.run_iter(_batches(6), "loss"):
+                pass
+        finally:
+            sess.close()
+        doc = json.loads(path.read_text())
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        names = {e["name"] for e in xs}
+        assert {"session.dispatch", "engine.step", "prefetch.place",
+                "engine.h2d_place"} <= names
+        dispatch_tids = {e["tid"] for e in xs
+                         if e["name"] == "session.dispatch"}
+        prefetch_tids = {e["tid"] for e in xs
+                         if e["name"] == "prefetch.place"}
+        assert dispatch_tids and prefetch_tids
+        assert dispatch_tids.isdisjoint(prefetch_tids)
+
+    def test_metrics_path_sink_and_snapshot(self, tmp_path):
+        path = tmp_path / "metrics.jsonl"
+        sess = _simple_session(metrics_path=str(path),
+                               metrics_interval_s=0.05)
+        try:
+            for _ in sess.run_iter(_batches(5), "loss"):
+                pass
+            snap = sess.metrics_snapshot()
+            assert snap["pipeline.steps"] == 5
+            assert snap["engine.builds"] == 1
+            assert snap["sparse.overflow_steps"] == 0
+            assert sess.steps_per_sec is None or sess.steps_per_sec > 0
+            time.sleep(0.12)
+        finally:
+            sess.close()
+        lines = [json.loads(line) for line in
+                 path.read_text().splitlines()]
+        assert lines, "sink wrote nothing"
+        # final flush at close carries the end-of-run state
+        assert lines[-1]["metrics"]["pipeline.steps"] == 5
+
+    def test_recompile_counter_flags_shape_retrace(self):
+        sess = _simple_session()
+        try:
+            sess.run("loss", feed_dict=_batches(1, batch=64)[0])
+            assert sess.metrics_snapshot()["engine.recompiles"] == 0
+            sess.run("loss", feed_dict=_batches(1, batch=32)[0])
+            sess.run("loss", feed_dict=_batches(1, batch=32)[0])
+            # one new signature = one retrace, repeat shapes don't count
+            assert sess.metrics_snapshot()["engine.recompiles"] == 1
+            # key order is not a shape change: jit caches on the sorted
+            # flattened pytree, so a reordered feed must not count
+            b = _batches(1, batch=32)[0]
+            sess.run("loss",
+                     feed_dict={k: b[k] for k in sorted(b, reverse=True)})
+            assert sess.metrics_snapshot()["engine.recompiles"] == 1
+        finally:
+            sess.close()
+
+    def test_pipeline_stats_still_rolls_up_through_run_iter(self):
+        sess = _simple_session()
+        try:
+            list(sess.run_iter(_batches(8), fetches=[]))
+            s = sess.pipeline_stats.summary()
+            assert s["steps"] == 8
+            assert s["h2d_bytes_per_step"] > 0
+            assert s["dispatch"]["p95_ms"] >= s["dispatch"]["p50_ms"] >= 0
+        finally:
+            sess.close()
+
+
+# -- ProfileHook.close (satellite) -----------------------------------------
+
+
+class TestProfileHookClose:
+    def _hook(self, tmp_path, monkeypatch, profile_range):
+        import jax
+        from parallax_tpu.profiler import ProfileHook
+        calls = []
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda path: calls.append(("start", path)))
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            lambda: calls.append(("stop",)))
+        hook = ProfileHook(parallax.ProfileConfig(
+            profile_dir=str(tmp_path), profile_range=profile_range),
+            worker_id=0)
+        return hook, calls
+
+    def test_close_stops_inflight_trace(self, tmp_path, monkeypatch):
+        """A profile_range extending past the last step leaves the
+        trace running; close() must stop it."""
+        hook, calls = self._hook(tmp_path, monkeypatch, (2, 100))
+        for step in range(5):  # training ends inside the range
+            hook.before_step(step)
+            hook.after_step(step)
+        assert hook.active
+        assert calls == [("start", calls[0][1])]
+        hook.close()
+        assert not hook.active
+        assert calls[-1] == ("stop",)
+        hook.close()  # idempotent
+        assert calls.count(("stop",)) == 1
+
+    def test_close_noop_when_range_completed(self, tmp_path,
+                                             monkeypatch):
+        hook, calls = self._hook(tmp_path, monkeypatch, (1, 3))
+        for step in range(5):
+            hook.before_step(step)
+            hook.after_step(step)
+        assert not hook.active
+        n_stops = calls.count(("stop",))
+        hook.close()
+        assert calls.count(("stop",)) == n_stops
+
+    def test_session_close_invokes_profile_close(self, tmp_path,
+                                                 monkeypatch):
+        import jax
+        calls = []
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda path: calls.append("start"))
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            lambda: calls.append("stop"))
+        sess = _simple_session(profile_config=parallax.ProfileConfig(
+            profile_dir=str(tmp_path), profile_range=(1, 1000)))
+        try:
+            for b in _batches(3):
+                sess.run("loss", feed_dict=b)
+            assert calls == ["start"]
+        finally:
+            sess.close()
+        assert calls == ["start", "stop"]
+
+
+# -- logging (satellite) ---------------------------------------------------
+
+
+class TestLoggingConfig:
+    def _restore(self):
+        fmt = logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s: %(message)s")
+        for h in parallax_log.handlers:
+            h.setFormatter(fmt)
+        parallax_log.setLevel("INFO")
+
+    def test_config_overrides_level_at_session_construction(self):
+        try:
+            sess = _simple_session(log_level="WARNING")
+            try:
+                assert parallax_log.level == logging.WARNING
+            finally:
+                sess.close()
+        finally:
+            self._restore()
+
+    def test_noop_without_knobs(self):
+        before = parallax_log.level
+        configure_logging()
+        assert parallax_log.level == before
+
+    def test_json_formatter_emits_parseable_records(self):
+        try:
+            configure_logging(level="INFO", json_format=True)
+            record = logging.LogRecord("PARALLAX", logging.WARNING,
+                                       __file__, 1, "msg %d of %s",
+                                       (7, "run"), None)
+            line = parallax_log.handlers[0].format(record)
+            doc = json.loads(line)
+            assert doc["level"] == "WARNING"
+            assert doc["msg"] == "msg 7 of run"
+            assert doc["logger"] == "PARALLAX"
+            assert "ts" in doc
+        finally:
+            self._restore()
+
+    def test_json_formatter_includes_exception(self):
+        fmt = JsonLogFormatter()
+        try:
+            raise RuntimeError("the cause")
+        except RuntimeError:
+            import sys
+            record = logging.LogRecord("PARALLAX", logging.ERROR,
+                                       __file__, 1, "failed", (),
+                                       sys.exc_info())
+        doc = json.loads(fmt.format(record))
+        assert "the cause" in doc["exc"]
+
+
+# -- overhead budget (acceptance) ------------------------------------------
+
+
+def test_obs_overhead_within_budget():
+    """tools/check_obs_overhead.py: the instrumented step loop stays
+    within 2% of uninstrumented wall-time on the simple model. The
+    decomposed measurement (see the tool's docstring) is deterministic
+    up to microbench jitter; two attempts absorb a pathological
+    scheduling spike."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.check_obs_overhead import measure
+    last = None
+    for _attempt in range(2):
+        result = measure(steps=40, ab_segments=4)
+        last = result
+        if result["overhead_frac"] <= 0.02:
+            break
+    assert last["overhead_frac"] <= 0.02, last
+    assert last["obs_us_per_step"] > 0  # it did measure something

@@ -1,0 +1,180 @@
+"""Micro-bench: the observability layer must cost <=2% of step wall-time.
+
+ISSUE 2 acceptance: the always-on instrumentation (spans + metrics
+registry, obs/) on the simple-model step loop stays within 2% of the
+uninstrumented loop. Run directly::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/check_obs_overhead.py
+
+or via tier-1 (tests/test_obs.py::test_obs_overhead_within_budget).
+
+Methodology — why not a plain A/B wall-clock diff: on a shared CI box
+the step-to-step wall time swings far more than 2% (measured ±10-20%
+between adjacent 15-step windows on the committed rig), so a direct
+subtraction would be pure noise at the tolerance being enforced. The
+obs layer, however, is *purely additive host-side code* on the dispatch
+path — instrumented time = uninstrumented time + (obs instrument
+executions × unit cost) — so the enforced number decomposes exactly:
+
+  1. run the real instrumented loop and COUNT the per-step instrument
+     executions from the layer itself (span events recorded, histogram
+     samples, counter increments — auto-adapts when instrumentation is
+     added or removed);
+  2. micro-bench each unit cost (min over many tight batches: minima
+     are robust to contention, which only ever adds time);
+  3. overhead = (counts x unit costs + the per-step batch-signature
+     check) / median step wall-time.
+
+The raw interleaved A/B comparison is still measured and reported
+(``ab_overhead_frac``) for eyeballing on a quiet machine; the asserted
+bound is the decomposed ``overhead_frac``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _unit_cost_us(fn, iters: int = 2000, batches: int = 7) -> float:
+    """Cost of fn() in microseconds: min over several tight batches."""
+    best = float("inf")
+    for _ in range(batches):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e6
+
+
+def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
+            seg_steps: int = 15) -> dict:
+    import numpy as np
+
+    import parallax_tpu as parallax
+    from parallax_tpu import obs
+    from parallax_tpu.obs import trace
+    from parallax_tpu.models import simple
+
+    sess, *_ = parallax.parallel_run(
+        simple.build_model(learning_rate=0.1),
+        parallax_config=parallax.Config(run_option="AR",
+                                        search_partitions=False))
+    rng = np.random.default_rng(0)
+    batches = [simple.make_batch(rng, batch) for _ in range(8)]
+    try:
+        for i in range(20):  # compile + warm caches
+            sess.run("loss", feed_dict=batches[i % 8])
+
+        # -- 1. instrumented loop: count real per-step executions ------
+        collector = trace.get_collector()
+        collector.clear()
+        before = sess.metrics.snapshot()
+        obs.enable()
+        times = []
+        last = None
+        for i in range(steps):
+            t0 = time.perf_counter()
+            last = sess.run("loss", feed_dict=batches[i % 8])
+            times.append(time.perf_counter() - t0)
+        float(last)  # drain
+        after = sess.metrics.snapshot()
+        spans_per_step = len(collector.events()) / steps
+
+        def _count(snap):
+            n = 0
+            for v in snap.values():
+                if isinstance(v, dict) and "count" in v:
+                    n += v["count"]
+            return n
+
+        def _incs(snap):
+            return sum(v for v in snap.values() if isinstance(v, int))
+
+        hist_per_step = (_count(after) - _count(before)) / steps
+        incs_per_step = (_incs(after) - _incs(before)) / steps
+        step_us = float(np.median(times)) * 1e6
+
+        # -- 2. unit costs ---------------------------------------------
+        def one_span():
+            with trace.span("obs-overhead-bench"):
+                pass
+
+        reg = obs.MetricsRegistry()
+        h = reg.histogram("obs-overhead-bench")
+        c = reg.counter("obs-overhead-bench-c")
+        span_us = _unit_cost_us(one_span)
+        hist_us = _unit_cost_us(lambda: h.record(1.0))
+        inc_us = _unit_cost_us(c.inc)
+        eng, b0 = sess.engine, batches[0]
+        sig_us = _unit_cost_us(lambda: eng._note_batch_signature(b0),
+                               iters=500)
+
+        obs_us = (spans_per_step * span_us + hist_per_step * hist_us
+                  + incs_per_step * inc_us + sig_us)
+        overhead_frac = obs_us / step_us
+
+        # -- 3. informational raw A/B (interleaved, min-of-segments) ---
+        def seg():
+            t0 = time.perf_counter()
+            r = None
+            for i in range(seg_steps):
+                r = sess.run("loss", feed_dict=batches[i % 8])
+            float(r)
+            return (time.perf_counter() - t0) / seg_steps
+
+        on, off = [], []
+        for s in range(2 * ab_segments):
+            if s % 2 == 0:
+                obs.enable()
+                on.append(seg())
+            else:
+                obs.disable()
+                off.append(seg())
+        obs.enable()
+        ab = min(on) / min(off) - 1.0
+
+        collector.clear()  # don't leave bench spans in the ring
+        return {
+            "overhead_frac": round(overhead_frac, 5),
+            "obs_us_per_step": round(obs_us, 2),
+            "step_us": round(step_us, 1),
+            "spans_per_step": round(spans_per_step, 2),
+            "hist_records_per_step": round(hist_per_step, 2),
+            "counter_incs_per_step": round(incs_per_step, 2),
+            "unit_costs_us": {"span": round(span_us, 3),
+                              "histogram_record": round(hist_us, 3),
+                              "counter_inc": round(inc_us, 3),
+                              "batch_signature": round(sig_us, 3)},
+            "ab_overhead_frac": round(ab, 4),
+        }
+    finally:
+        from parallax_tpu import obs as _obs
+        _obs.enable()
+        sess.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--max-overhead", type=float, default=0.02,
+                    help="fail when the decomposed overhead fraction "
+                         "exceeds this (default 0.02 = 2%%)")
+    args = ap.parse_args(argv)
+    result = measure(steps=args.steps, batch=args.batch)
+    result["max_overhead"] = args.max_overhead
+    result["ok"] = result["overhead_frac"] <= args.max_overhead
+    print(json.dumps(result, indent=2))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
