@@ -24,7 +24,7 @@ from parallax_tpu.parallel.partitions import get_partitioner
 from parallax_tpu.runner import parallel_run
 from parallax_tpu.session import (Fetch, ParallaxSession, StepHandle,
                                   materialize)
-from parallax_tpu import obs, ops, shard
+from parallax_tpu import compile, obs, ops, shard  # noqa: A004
 
 __version__ = "0.1.0"
 
@@ -32,6 +32,6 @@ __all__ = [
     "get_partitioner", "parallel_run", "shard", "log", "Config",
     "ParallaxConfig", "PSConfig", "MPIConfig", "CommunicationConfig",
     "CheckPointConfig", "ProfileConfig", "Model", "TrainState",
-    "ParallaxSession", "Fetch", "StepHandle", "materialize", "obs",
-    "ops",
+    "ParallaxSession", "Fetch", "StepHandle", "materialize", "compile",
+    "obs", "ops",
 ]

@@ -176,6 +176,10 @@ class ParallaxConfig:
     * ``prefetch_depth`` / ``eager_fetch``: async step pipeline knobs
       (no reference analogue — the reference's tf.data input pipeline
       owned this); see the field comments and session.py.
+    * ``shape_buckets`` / ``bucket_mask_feed`` /
+      ``compilation_cache_dir``: the compile-ahead engine (compile/) —
+      batch-shape bucketing, AOT warmup and executable/compilation
+      caching; see the field comments and compile/__init__.py.
     * ``trace_path`` / ``metrics_path`` / ``metrics_interval_s`` /
       ``monitor_health`` / ``log_level`` / ``log_json``: the unified
       observability layer (obs/) — always-on span tracing + metrics
@@ -194,6 +198,27 @@ class ParallaxConfig:
     # bounding host+HBM staging memory; raise it only when feed prep has
     # high variance.
     prefetch_depth: int = 2
+    # -- compile-ahead engine (compile/) ---------------------------------
+    # Batch-shape buckets: ascending batch sizes every feed batch is
+    # padded up to (smallest bucket that fits), or "auto" (= the first
+    # batch's size, covering the classic ragged final tail). Padded
+    # rows get the mask feed zeroed so a weight-normalized loss stays
+    # exact; full batches pass through bit-identical. None (default) =
+    # no bucketing: every new batch shape retraces the step (counted by
+    # engine.recompiles).
+    shape_buckets: Union[None, str, Sequence[int]] = None
+    # The per-example weight feed bucketing masks: an existing feed of
+    # this name (e.g. lm1b's "w") has its padded rows zeroed; when
+    # absent, a [bucket] float32 mask (1=real, 0=padding) is added
+    # under this name on every batch so the feed structure stays
+    # signature-stable.
+    bucket_mask_feed: str = "w"
+    # Directory for JAX's persistent compilation cache: repeated
+    # launches of the same program skip XLA entirely (compiles become
+    # disk reads). Process-global; keyed by HLO + compile environment,
+    # so a stale cache can only miss, never corrupt. None = leave the
+    # process setting alone.
+    compilation_cache_dir: Optional[str] = None
     # When True, ``run()`` materializes every fetch to a host value
     # before returning (the pre-async blocking behavior). Default False:
     # fetches come back as lazy ``Fetch`` handles and the host thread is
@@ -277,6 +302,17 @@ class ParallaxConfig:
             raise ValueError(
                 f"trace_buffer_events must be >= 1, got "
                 f"{self.trace_buffer_events}")
+        if self.shape_buckets is not None:
+            # one validation rule, owned by compile/bucketing.py (the
+            # lazy import keeps config importable before the package
+            # finishes initializing); 'auto' stays the string — it
+            # resolves against the first real batch at engine build
+            from parallax_tpu.compile.bucketing import resolve_buckets
+            resolved = resolve_buckets(self.shape_buckets, 1)
+            if not isinstance(self.shape_buckets, str):
+                self.shape_buckets = resolved
+        if not self.bucket_mask_feed:
+            raise ValueError("bucket_mask_feed must be a feed name")
 
     # Reference-style setters (kept so ported driver code works unchanged).
     def set_sync(self, sync: bool) -> None:

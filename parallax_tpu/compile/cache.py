@@ -1,0 +1,98 @@
+"""Engine and executable caching across rebuilds and relaunches.
+
+Two cache layers with different lifetimes:
+
+* ``EngineCache`` (in-process): built ``Engine`` objects keyed by
+  ``(num_partitions, batch-signature)``. The partition search replans
+  by rebuilding the engine per candidate; before this cache the search
+  then rebuilt — and re-jitted, and recompiled — the WINNING candidate
+  a second time after it had already been measured
+  (``session._record_search_time``). A cached engine keeps its jitted
+  step's compiled-executable cache, so switching back to the winner is
+  a dictionary lookup plus a state reshard, zero XLA work.
+
+* JAX's persistent compilation cache (on-disk, cross-process):
+  ``Config(compilation_cache_dir=...)`` wires it for the session, so a
+  relaunched job (same model, same toolchain) skips XLA entirely —
+  compiles become disk reads. Keyed by HLO + compile environment: a
+  stale cache can only miss, never corrupt.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.obs import metrics as obs_metrics
+
+
+def enable_persistent_cache(cache_dir: str,
+                            min_compile_secs: float = 0.0) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Process-global (the cache is a backend property). Returns False —
+    with a warning, never an exception — on toolchains without the
+    config knobs, so a session on an old jax still runs, just
+    uncached.
+    """
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+        parallax_log.info("persistent compilation cache at %s", cache_dir)
+        return True
+    except Exception as e:  # older jax without the knobs
+        parallax_log.warning(
+            "compilation_cache_dir=%s has no effect on this jax "
+            "build (%s); compiles will not persist", cache_dir, e)
+        return False
+
+
+class EngineCache:
+    """Built engines keyed by ``(num_partitions, batch-signature)``.
+
+    The session keys with the BUCKETED example-batch signature
+    (``ParallaxSession._bucketed_example``): ragged and full example
+    batches of one bucket key identically, so a ragged tail landing
+    right before the partition search settles cannot make the winner
+    lookup miss. Without buckets declared the raw signature is the
+    key. Hit/miss counts flow through the session's registry
+    (``session.engine_cache.*``).
+    """
+
+    def __init__(self, metrics: Optional[obs_metrics.MetricsRegistry]
+                 = None):
+        registry = metrics if metrics is not None \
+            else obs_metrics.MetricsRegistry()
+        self._hits = registry.counter("session.engine_cache.hits")
+        self._misses = registry.counter("session.engine_cache.misses")
+        self._engines: Dict[Tuple, object] = {}
+
+    def get(self, key: Tuple):
+        eng = self._engines.get(key)
+        if eng is not None:
+            self._hits.inc()
+        else:
+            self._misses.inc()
+        return eng
+
+    def put(self, key: Tuple, engine) -> None:
+        self._engines[key] = engine
+
+    def prune(self, keep) -> int:
+        """Drop every cached engine except ``keep`` (the search winner)
+        and return how many were dropped. Dropped engines are NOT
+        ``close()``d: close() restores process-global jax settings
+        (``jax_debug_nans``) that the surviving engine still owns —
+        the executables they hold are freed by GC."""
+        dropped = [k for k, e in self._engines.items() if e is not keep]
+        for k in dropped:
+            del self._engines[k]
+        return len(dropped)
+
+    def engines(self):
+        return list(self._engines.values())
+
+    def __len__(self) -> int:
+        return len(self._engines)

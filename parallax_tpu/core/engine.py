@@ -51,6 +51,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from parallax_tpu.common import consts
 from parallax_tpu.common.config import ParallaxConfig
 from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.compile import bucketing, warmup as warmup_lib
 from parallax_tpu.core import classify, mesh as mesh_lib, specs as specs_lib
 from parallax_tpu.obs import _state as obs_state, \
     metrics as obs_metrics, trace
@@ -298,6 +299,31 @@ class Engine:
         # batch-shape signatures already traced: a growing set means
         # shape-driven retraces (each one a full XLA compile)
         self._traced_signatures: set = set()
+        # -- compile-ahead engine (compile/) -----------------------------
+        # AOT-compiled step executables keyed by batch signature
+        # (warmup()); step() dispatches to these before falling back to
+        # the jit cache
+        self._executables: Dict[Tuple, Any] = {}
+        self._exec_hits = self.metrics.counter(
+            "engine.executable_cache.hits")
+        self._exec_misses = self.metrics.counter(
+            "engine.executable_cache.misses")
+        self.warmup_seconds: Dict[int, float] = {}
+        # batch-shape buckets: pad ragged batches onto a declared
+        # signature set (compile/bucketing.py) so retraces are bounded
+        self._buckets = None
+        if config.shape_buckets is not None:
+            if not isinstance(example_batch, dict):
+                raise ValueError(
+                    "shape_buckets requires dict feeds (name -> array); "
+                    "got a %s example batch" % type(example_batch).__name__)
+            local_n = max(1, mesh_lib.num_devices(mesh)
+                          // jax.process_count())
+            lead = bucketing._leading_dim(example_batch)
+            self._buckets = bucketing.resolve_buckets(
+                config.shape_buckets, lead if lead else 1, local_n)
+            example_batch, _ = bucketing.bucket_batch(
+                example_batch, self._buckets, config.bucket_mask_feed)
         if not config.sync:
             parallax_log.info(
                 "sync=False: running bounded-staleness delayed-gradient "
@@ -327,6 +353,21 @@ class Engine:
             self._params_shapes = params_shapes
             self._mstate_shapes = mstate_shapes
             self._batch_shapes = batch_shapes
+            self._example_batch_dim = (
+                bucketing._leading_dim(example_batch)
+                if isinstance(example_batch, dict) else None)
+            if self._buckets and isinstance(batch_shapes, dict):
+                # declared buckets are EXPECTED signatures: pre-register
+                # them so a multi-bucket stream never counts into
+                # engine.recompiles (each bucket still costs one
+                # compile — warmup() pays it ahead of step 0). Post-
+                # placement signatures carry global shapes, hence the
+                # process scale.
+                for sig in bucketing.bucket_signatures(
+                        batch_shapes, self._example_batch_dim,
+                        self._buckets,
+                        process_scale=self._feed_process_scale):
+                    self._traced_signatures.add(sig)
             self.plan = build_plan(model, mesh, config, params_shapes,
                                    batch_shapes, mstate_shapes)
             self._param_shardings = jax.tree.map(
@@ -666,34 +707,116 @@ class Engine:
         # preplaced run_iter path then see the same (global) array
         # shapes — the ones _step_jit actually caches on — so mixing
         # the two paths can't fake a retrace on multi-host
-        self._note_batch_signature(batch)
+        sig = exe = None
+        if self._executables:
+            sig = bucketing.batch_signature(batch)
+            exe = self._executables.get(sig)
+        self._note_batch_signature(batch, sig)
         with trace.span("engine.step"), self.mesh:
-            new_state, outputs = self._step_jit(state, batch)
+            if exe is not None:
+                try:
+                    new_state, outputs = exe(state, batch)
+                    self._exec_hits.inc()
+                except (TypeError, ValueError) as e:
+                    # input rejection (shape/dtype/pytree/sharding
+                    # drift, e.g. a shape-changing feed_transform) —
+                    # raised BEFORE dispatch, so ``state`` is untouched:
+                    # drop the executable and take the jit path, which
+                    # compiles for whatever the inputs really are. A
+                    # runtime failure (OOM, debug_nans) propagates
+                    # instead: the state was donated, and retrying on
+                    # deleted buffers would only mask the real error.
+                    del self._executables[sig]
+                    parallax_log.warning(
+                        "AOT executable rejected its inputs (%s); "
+                        "falling back to the jit path for signature %s",
+                        e, sig)
+                    new_state, outputs = self._step_jit(state, batch)
+            else:
+                if self._executables:
+                    self._exec_misses.inc()
+                new_state, outputs = self._step_jit(state, batch)
         if not self._exported_graph and self.config.export_graph_path:
             self._export_graph(state, batch)
         return new_state, outputs
 
-    def _note_batch_signature(self, batch) -> None:
+    def warmup(self, state: TrainState,
+               batch_sizes: Optional[Sequence[int]] = None
+               ) -> Dict[int, float]:
+        """AOT-compile the step executable for every declared batch
+        bucket (``Config.shape_buckets``) — or for explicit
+        ``batch_sizes`` — ahead of step 0, so no step in a bucketed
+        stream ever stalls on an XLA compile. Lowers against ``state``'s
+        real shardings; idempotent (already-compiled sizes are
+        skipped). Returns {batch_size: compile_seconds}; also recorded
+        in ``warmup_seconds`` and the ``engine.compile_seconds``
+        histogram."""
+        return warmup_lib.aot_warmup(self, state, batch_sizes)
+
+    def _feed_sharding(self, name: str, ndim: int) -> NamedSharding:
+        """The placement ``shard_batch`` will give feed ``name`` — the
+        sharding warmup avals must carry for the AOT executable to
+        accept real placed batches."""
+        spec = self.model.batch_specs.get(name)
+        if spec is not None:
+            return NamedSharding(self.mesh, spec)
+        return self.batch_sharding_fn(ndim)
+
+    def _feed_process_scale(self, name: str) -> int:
+        """local-to-global dim-0 factor for feed ``name``: how many
+        processes its dim-0 placement spans. Default batch sharding
+        spans every process; a ``batch_specs`` override only scales by
+        the process span of its dim-0 mesh axes (a replicated or
+        intra-process axis spans 1)."""
+        if jax.process_count() == 1:
+            return 1
+        spec = self.model.batch_specs.get(name)
+        if spec is None:
+            return jax.process_count()
+        if len(spec) == 0 or spec[0] is None:
+            return 1
+        axes = ((spec[0],) if isinstance(spec[0], str)
+                else tuple(spec[0]))
+        return int(np.prod([_process_span(self.mesh, a)
+                            for a in axes]))
+
+    def _bucket_avals(self, b: int) -> Dict[str, Any]:
+        """Abstract batch (ShapeDtypeStructs with shardings) for bucket
+        size ``b``: the example batch's shape tree with every
+        batch-leading dim re-sized. Dims are global (multi-host
+        placement scales the local feed by the process count); assumes
+        shape-preserving feed_transforms — a transform that re-shapes
+        makes the executable an unused cache entry (a per-step miss),
+        never a wrong result."""
+        if not isinstance(self._batch_shapes, dict):
+            raise ValueError("warmup requires dict feeds (name -> array)")
+        out = {}
+        for name, leaf in self._batch_shapes.items():
+            shape = bucketing.bucket_shape(
+                tuple(leaf.shape), self._example_batch_dim, b,
+                self._feed_process_scale(name))
+            out[name] = jax.ShapeDtypeStruct(
+                shape, leaf.dtype,
+                sharding=self._feed_sharding(name, len(shape)))
+        return out
+
+    def _note_batch_signature(self, batch, sig=None) -> None:
         """Flag silent shape-driven retraces: every batch shape/dtype
         signature beyond the first costs a full XLA recompile of the
         step — a loop feeding ragged final batches is compile-bound
         while looking healthy. Counted as ``engine.recompiles`` and
-        warned once per new signature."""
+        warned once per new signature. Declared ``shape_buckets``
+        signatures are pre-registered as expected and never count.
+        ``sig``: the signature when the step dispatch already computed
+        it (compile/bucketing.batch_signature — the same sorted
+        fast-path as below)."""
         if not obs_state.enabled:
             return
-        try:
-            # fast path: flat dict of arrays (every session feed after
-            # _convert_feed) — the pytree walk below costs ~4x more.
-            # sorted: jit's cache keys on the SORTED flattened pytree,
-            # so insertion order must not fake a retrace
-            sig = tuple(sorted((k, tuple(v.shape), str(v.dtype))
-                               for k, v in batch.items()))
-        except AttributeError:
-            sig = tuple(
-                (classify._pathname(kp), tuple(np.shape(leaf)),
-                 str(_dtype_of(leaf)))
-                for kp, leaf in
-                jax.tree_util.tree_flatten_with_path(batch)[0])
+        if sig is None:
+            # ONE signature function for noting, dispatch and
+            # pre-registration: a second implementation here could
+            # key the same batch two ways and fake a retrace
+            sig = bucketing.batch_signature(batch)
         if sig in self._traced_signatures:
             return
         first = not self._traced_signatures
@@ -702,8 +825,11 @@ class Engine:
             self._recompiles.inc()
             parallax_log.warning(
                 "new batch shape signature #%d triggers an XLA retrace "
-                "of the step (signature: %s); pad batches to a fixed "
-                "shape to avoid recompiles",
+                "of the step (signature: %s); declare "
+                "Config.shape_buckets=[...] (or 'auto') so ragged "
+                "batches are padded onto a fixed set of compiled "
+                "bucket shapes — see docs/parallax_api.md "
+                "'Compilation, warmup & caching'",
                 len(self._traced_signatures) - 1,
                 [(n, s) for n, s, _ in sig])
 
@@ -719,8 +845,16 @@ class Engine:
         """Place a host batch onto the mesh, sharded on dim 0 by default
         (the reference's per-replica feed splitting,
         session_context.py:205-233); Model.batch_specs overrides the
-        layout per feed name (e.g. sequence-parallel inputs)."""
+        layout per feed name (e.g. sequence-parallel inputs). With
+        ``Config.shape_buckets`` declared, ragged batches are first
+        padded up to their bucket with the mask feed zeroed over the
+        tail (compile/bucketing.py) — full batches pass through
+        bit-identical — so every caller (run / run_iter / place_batch /
+        prefetch_to_device) presents a bounded signature set."""
         with trace.span("engine.h2d_place"):
+            if self._buckets is not None and isinstance(batch, dict):
+                batch, _ = bucketing.bucket_batch(
+                    batch, self._buckets, self.config.bucket_mask_feed)
             return self._shard_batch_impl(batch)
 
     def _shard_batch_impl(self, batch):

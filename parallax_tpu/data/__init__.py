@@ -1,6 +1,7 @@
+from parallax_tpu.compile.bucketing import bucket_batch
 from parallax_tpu.data.loader import (TokenDataset, prefetch_to_device,
                                       write_token_file)
 from parallax_tpu.data.prefetch import Prefetcher
 
 __all__ = ["TokenDataset", "write_token_file", "prefetch_to_device",
-           "Prefetcher"]
+           "Prefetcher", "bucket_batch"]

@@ -195,9 +195,12 @@ def prefetch_to_device(batches: Iterable, place_fn: Callable,
     feed conversion + H2D transfer for batch *t+1* overlap step *t*'s
     device compute. ``place_fn`` maps one host batch to its placed form
     — pass ``session.place_batch`` (feed conversion + ``shard_batch``,
-    incl. ``feed_transforms`` and multi-host
-    ``make_array_from_process_local_data``) and feed the yielded batches
-    to ``session.run_iter(..., placed=True)`` or
+    incl. ``feed_transforms``, batch-shape bucketing when
+    ``Config.shape_buckets`` is declared — ragged batches from an
+    external pipeline are padded onto their bucket with the ``"w"``
+    mask zeroed, so they can't silently retrace the step — and
+    multi-host ``make_array_from_process_local_data``) and feed the
+    yielded batches to ``session.run_iter(..., placed=True)`` or
     ``engine.step(state, b, preplaced=True)``. At most ``depth`` placed
     batches are held at once. Returns a ``Prefetcher`` (an iterator;
     also a context manager — ``close()`` stops the thread)."""

@@ -10,7 +10,11 @@ remedy — a daemon thread pulls items from an iterator, runs an arbitrary
 bounded queue, so batch *t+1* is already on device when step *t*
 retires. Used by ``ParallaxSession.run_iter`` and by the
 ``prefetch_to_device`` adapter chained onto the native C++ token
-loader's own background thread (data/loader.py).
+loader's own background thread (data/loader.py). When the place_fn is
+``session.place_batch`` and ``Config.shape_buckets`` is declared, the
+pad-and-mask bucketing transform (compile/bucketing.py) runs on this
+thread too — ragged batches are already padded onto their compiled
+bucket signature by the time the dispatch thread sees them.
 
 Semantics:
   * strict FIFO — results come out in iterator order, always;

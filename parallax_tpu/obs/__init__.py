@@ -13,7 +13,10 @@ Three parts, one import:
   * :mod:`~parallax_tpu.obs.health` — opt-in per-step loss-finiteness
     and grad-global-norm monitoring (``Config(monitor_health=True)``,
     computed in-graph, fetched lazily), device memory stats, and the
-    engine's recompilation counter.
+    engine's recompilation counter (driven to zero by the compile-ahead
+    engine, :mod:`parallax_tpu.compile`, whose ``engine.compile_seconds``
+    histogram and ``engine.executable_cache.*`` /
+    ``session.engine_cache.*`` counters also live in the registry).
 
 ``disable()`` / ``enable()`` (or env ``PARALLAX_OBS=0``) switch the
 whole layer to near-free no-ops process-wide;

@@ -98,6 +98,16 @@ class PartitionSearch:
         pred = [coef[0] / d + coef[1] * (d - 1) + coef[2] for d in cands]
         self._best = cands[int(np.argmin(pred))]
 
+    def tried_partitions(self) -> List[int]:
+        """Distinct candidate sizes measured so far. The session keeps
+        one built engine per entry in its engine cache
+        (compile/cache.py), so settling on any measured candidate —
+        the winner included — reuses its compiled step instead of
+        rebuilding it (the reference relaunched the whole cluster per
+        switch; pre-cache we still re-jitted and recompiled the
+        winner after the search had already measured it)."""
+        return sorted({p for p, _ in self.results})
+
     def best_partitions(self) -> int:
         if self._best is None:
             self._fit()

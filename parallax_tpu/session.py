@@ -49,6 +49,8 @@ import numpy as np
 from parallax_tpu.common import consts
 from parallax_tpu.common.config import ParallaxConfig
 from parallax_tpu.common.lib import configure_logging, parallax_log
+from parallax_tpu.compile import bucketing as bucketing_lib, \
+    cache as compile_cache
 from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
 from parallax_tpu.checkpoint import CheckpointHook
 from parallax_tpu.obs import trace
@@ -278,6 +280,18 @@ class ParallaxSession:
             if config.metrics_path else None)
         self._last_dispatch_end: Optional[float] = None
         self._prefetcher = None
+        # -- compile-ahead engine (compile/) ----------------------------
+        # built engines keyed by (num_partitions, example-batch
+        # signature): the partition search reuses the measured winner
+        # instead of rebuilding (and recompiling) it
+        self._engine_cache = compile_cache.EngineCache(self.metrics)
+        # ALL background warmup threads ever started (a second
+        # warmup() call must not orphan the first thread — close()
+        # joins every one)
+        self._warmup_threads: List[threading.Thread] = []
+        if config.compilation_cache_dir:
+            compile_cache.enable_persistent_cache(
+                config.compilation_cache_dir)
 
     # -- lazy build (needs the first batch to know shapes) ----------------
 
@@ -303,10 +317,29 @@ class ParallaxSession:
             self._host_step = int(self._state.step)
 
     def _build_engine(self, example_batch, num_partitions):
-        mesh = mesh_lib.build_mesh(num_partitions=num_partitions)
-        self._engine = engine_lib.Engine(self._model, mesh, self._config,
-                                         example_batch,
-                                         metrics=self.metrics)
+        # Bucket the example up front (no-op without shape_buckets):
+        # _last_example_batch is whatever fed last, and a ragged tail
+        # landing right before a replan must neither make the winner
+        # lookup miss nor — under shape_buckets='auto' — re-resolve
+        # the new engine's bucket set from its own odd size (the
+        # bucketed example keeps 'auto' pinned to the first engine's
+        # bucket across replans).
+        example_batch = self._bucketed_example(example_batch)
+        # cache key: the (bucketed) example-batch signature — a cached
+        # engine keeps its jitted step's compiled executables, so a
+        # partition replan back onto a measured candidate (above all:
+        # the search winner) costs a lookup + state reshard instead of
+        # a rebuild and a full recompile.
+        key = (num_partitions,
+               bucketing_lib.batch_signature(example_batch))
+        engine = self._engine_cache.get(key)
+        if engine is None:
+            mesh = mesh_lib.build_mesh(num_partitions=num_partitions)
+            engine = engine_lib.Engine(self._model, mesh, self._config,
+                                       example_batch,
+                                       metrics=self.metrics)
+            self._engine_cache.put(key, engine)
+        self._engine = engine
         if self._state is None:
             self._state = self._engine.init_state(self._seed)
         else:
@@ -314,6 +347,32 @@ class ParallaxSession:
             # the reference instead kills and relaunches the cluster
             # (partitions.py:74-138).
             self._state = self._reshard_state(self._state)
+
+    def _bucketed_example(self, example_batch):
+        """The example batch as the engine will see it: bucketed when
+        ``Config.shape_buckets`` is declared. Buckets resolve from the
+        live engine when one exists (keeps 'auto' keying stable across
+        replans — the first engine's bucket, not each ragged example's
+        own size); resolution failures fall back to the raw batch (a
+        conservative key: at most a redundant build, never a wrong
+        engine)."""
+        cfg = self._config
+        if cfg.shape_buckets is None \
+                or not isinstance(example_batch, dict):
+            return example_batch
+        try:
+            buckets = (self._engine._buckets
+                       if self._engine is not None else None)
+            if buckets is None:
+                lead = bucketing_lib._leading_dim(example_batch)
+                buckets = bucketing_lib.resolve_buckets(
+                    cfg.shape_buckets, lead if lead else 1)
+            if not buckets:
+                return example_batch
+            return bucketing_lib.bucket_batch(
+                example_batch, buckets, cfg.bucket_mask_feed)[0]
+        except ValueError:
+            return example_batch
 
     def _reshard_state(self, state):
         """Move the whole live state onto the new mesh. Params take the new
@@ -384,6 +443,13 @@ class ParallaxSession:
         while step *t* executes on device. Results come back in batch
         order with the exact ``run()`` fetch contract — same losses,
         bit for bit, as the sequential loop.
+
+        With ``Config.shape_buckets`` declared, every batch — above
+        all the final partial one, the classic silent-retrace case —
+        is padded onto its bucket inside ``shard_batch``, so a ragged
+        iterator presents a bounded signature set and
+        ``engine.recompiles`` stays 0 (pair with ``session.warmup()``
+        to also pay those compiles before step 0).
 
         ``placed=True`` skips the internal prefetcher and treats each
         item as already device-placed (chain
@@ -564,6 +630,76 @@ class ParallaxSession:
                 pass
         return self.metrics.snapshot()
 
+    # -- compile-ahead engine (compile/) ----------------------------------
+
+    def warmup(self, feed_dict: Optional[Dict[str, Any]] = None,
+               batch_sizes: Optional[Sequence[int]] = None,
+               background: bool = False):
+        """AOT-compile the step for every declared batch bucket
+        (``Config.shape_buckets``) — or explicit ``batch_sizes`` —
+        ahead of step 0, so the first step of each bucket dispatches a
+        ready executable instead of stalling on an XLA compile.
+
+        ``feed_dict``: an example feed to build the engine from when it
+        doesn't exist yet (equivalent to ``prepare(feed_dict)`` first).
+        ``background=True`` runs the compiles on a daemon thread —
+        overlapping warmup with data-pipeline startup — and returns the
+        ``threading.Thread`` (``join()`` it, or just start stepping:
+        steps the warmup hasn't reached yet take the normal jit path);
+        otherwise blocks and returns {batch_size: compile_seconds}.
+        """
+        if feed_dict is not None:
+            self.prepare(feed_dict)
+        if self._engine is None:
+            raise ValueError(
+                "warmup needs an engine: pass feed_dict (or call "
+                "prepare(example_feed)) first")
+        if not background:
+            with trace.span("session.warmup"):
+                return self._engine.warmup(self._state, batch_sizes)
+
+        def _bg():
+            try:
+                with trace.span("session.warmup", background=True):
+                    self._engine.warmup(self._state, batch_sizes)
+            except Exception as e:  # warmup is an optimization: a
+                # failure must never kill the training process
+                parallax_log.warning("background warmup failed: %s", e)
+
+        t = threading.Thread(target=_bg, name="parallax-warmup",
+                             daemon=True)
+        self._warmup_threads.append(t)
+        t.start()
+        return t
+
+    def compile_stats(self) -> Dict[str, Any]:
+        """JSON-ready compile/caching report (bench.py stamps this into
+        the BENCH line): declared bucket sizes, per-bucket AOT compile
+        seconds, and the executable-/engine-cache hit and miss
+        counters."""
+        eng = self._engine
+        return {
+            "shape_buckets": (list(eng._buckets)
+                              if eng is not None and eng._buckets
+                              else None),
+            "warmup_compile_seconds": (
+                {str(k): round(v, 3)
+                 for k, v in sorted(eng.warmup_seconds.items())}
+                if eng is not None else {}),
+            "executable_cache": {
+                "hits": self.metrics.counter(
+                    "engine.executable_cache.hits").value,
+                "misses": self.metrics.counter(
+                    "engine.executable_cache.misses").value,
+            },
+            "engine_cache": {
+                "hits": self.metrics.counter(
+                    "session.engine_cache.hits").value,
+                "misses": self.metrics.counter(
+                    "session.engine_cache.misses").value,
+            },
+        }
+
     # -- partition search (reference: common/partitions.py) ---------------
 
     def _record_search_time(self, dt: float) -> None:
@@ -590,7 +726,17 @@ class ParallaxSession:
                 "partition search done: best num_partitions=%d", best)
             self._search = None
             if best != mesh_lib.num_shards(self._engine.mesh):
+                # the winner was already built (and compiled, and
+                # measured) as a candidate: _build_engine reuses it
+                # from the engine cache
                 self._build_engine_from_live(best)
+            # the losing candidates' engines (and their executables)
+            # are no longer reachable by any replan — free them
+            dropped = self._engine_cache.prune(keep=self._engine)
+            if dropped:
+                parallax_log.info(
+                    "partition search: dropped %d losing candidate "
+                    "engine(s) from the cache", dropped)
         else:
             parallax_log.info("partition search: trying p=%d", nxt)
             self._build_engine_from_live(nxt)
@@ -664,6 +810,14 @@ class ParallaxSession:
         if self._prefetcher is not None:
             self._prefetcher.close()
             self._prefetcher = None
+        for t in self._warmup_threads:
+            # a background warmup still compiling must not race the
+            # engine teardown below (it reads and writes engine state):
+            # join unbounded — an XLA compile always terminates, and a
+            # timed-out join would just resume the race the join
+            # exists to prevent
+            t.join()
+        self._warmup_threads = []
         try:
             self._warn_sparse_overflow("close")
         except Exception as e:  # reads live opt_state: can race donation

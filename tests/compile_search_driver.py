@@ -1,0 +1,77 @@
+"""Subprocess driver: partition auto-search with the engine cache.
+
+Spawned by tests/test_compile.py (pattern of multihost_driver.py):
+drives the live search loop end-to-end and prints ONE JSON line with
+what the engine cache did, so the assertions run in the parent. Run in
+a child process because a long multi-mesh search — many compiled
+programs + live state reshards in one process — intermittently
+hard-crashes this XLA:CPU toolchain when stacked on top of a dense
+suite's accumulated state; isolation keeps a toolchain abort from
+killing the whole tier-1 run.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8"
+                           ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# fresh compiles: executing disk-deserialized donated executables is
+# part of the flaky-toolchain surface this driver exists to avoid
+jax.config.update("jax_compilation_cache_dir", None)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import parallax_tpu as parallax  # noqa: E402
+from parallax_tpu.common import consts as c  # noqa: E402
+from parallax_tpu.core import mesh as mesh_lib  # noqa: E402
+from parallax_tpu.ops import embedding as emb_ops  # noqa: E402
+
+
+def main() -> int:
+    c.NUM_ITERATIONS_FOR_WARMUP = 1
+    c.NUM_ITERATIONS_FOR_TEST = 3
+    os.environ[c.PARALLAX_MIN_PARTITIONS] = "1"
+    V, D = 32, 8
+
+    model = parallax.Model(
+        lambda rng: {"emb": jax.random.normal(rng, (V, D)) * 0.1},
+        lambda params, batch: jnp.mean(
+            emb_ops.embedding_lookup(params["emb"], batch["ids"]) ** 2),
+        optimizer=optax.sgd(0.1))
+    sess, *_ = parallax.parallel_run(
+        model, parallax_config=parallax.Config(run_option="HYBRID"))
+    rng = np.random.default_rng(42)
+    engines = {}
+    search = sess._search
+    converged = False
+    for _ in range(60):
+        sess.run("loss", feed_dict={
+            "ids": rng.integers(0, V, (16,)).astype(np.int32)})
+        if sess._search is None:
+            converged = True
+            break
+        engines[mesh_lib.num_shards(sess.engine.mesh)] = sess.engine
+    result = {
+        "converged": converged,
+        "tried": search.tried_partitions(),
+        "builds": sess.metrics.counter("engine.builds").value,
+        "winner_is_measured_candidate":
+            any(sess.engine is e for e in engines.values()),
+        "cache_len": len(sess._engine_cache),
+        "engine_cache": sess.compile_stats()["engine_cache"],
+    }
+    sess.close()
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,29 @@ def _mesh(repl=2, shard=4):
     return Mesh(devs, (AXIS_REPL, AXIS_SHARD))
 
 
+@pytest.fixture
+def partitionable_rng():
+    """Sharding-invariant param init for TP-vs-DP trajectory parity.
+
+    The legacy (non-partitionable) threefry — this toolchain's default
+    — lowers ``jax.random.normal`` differently depending on the OUTPUT
+    sharding GSPMD propagates into it: a row-sharded ``wo``/``w2``
+    (P('shard', None)) gets *different init values* than the same key
+    replicated or column-sharded, so a TP run and a DP run of the same
+    model never start from the same weights and their loss
+    trajectories diverge from step 0 (~2% on the first forward — the
+    pre-PR-1 failure mode of the two tests below). With
+    ``jax_threefry_partitionable=True`` random values are independent
+    of sharding by construction, which is exactly parallax's
+    transparency contract for these parity tests. Scoped here (flag
+    restored after) so the rest of the suite keeps the toolchain's
+    default stream."""
+    was = jax.config.jax_threefry_partitionable
+    jax.config.update("jax_threefry_partitionable", True)
+    yield
+    jax.config.update("jax_threefry_partitionable", was)
+
+
 # ---------------------------------------------------------------- op level
 
 
@@ -143,7 +166,8 @@ def _lc_run(parallelism, batches, num_partitions, **cfg_kw):
 
 
 @pytest.mark.slow
-def test_tp_weights_sharded_and_trajectory_matches_dp(rng):
+def test_tp_weights_sharded_and_trajectory_matches_dp(rng,
+                                                      partitionable_rng):
     batches = [lc.make_batch(rng, 8, 32, 512) for _ in range(4)]
     tp_losses, tp_state = _lc_run("tensor", batches, 4)   # repl=2, tp=4
     dp_losses, _ = _lc_run("data", batches, 1)            # pure dp over 8
@@ -206,7 +230,7 @@ def test_bert_tp_trajectory_matches_dp():
 
 
 @pytest.mark.slow
-def test_nmt_tp_trajectory_matches_dp():
+def test_nmt_tp_trajectory_matches_dp(partitionable_rng):
     def run(tensor_parallel, num_partitions):
         cfg = nmt.tiny_config(compute_dtype=jnp.float32,
                               tensor_parallel=tensor_parallel)
