@@ -306,6 +306,17 @@ def _run(model, cfg, batch_size, num_steps, steps, warmup, run_option,
         del sess
 
 
+def _harness_hash() -> str:
+    """sha256 of this file's bytes: two rounds with equal hashes ran
+    the IDENTICAL harness, so a headline delta is the build's."""
+    import hashlib
+    try:
+        with open(os.path.abspath(__file__), "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return "unknown"
+
+
 def worker_main():
     import jax
 
@@ -394,6 +405,60 @@ def worker_main():
             print(f"# health probe failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
 
+    # Serve section (ISSUE 4): the serving subsystem's own headline —
+    # a short mixed-length closed-loop load through ServeSession
+    # (tools/loadgen.py), stamped so request-path latency/QPS get a
+    # per-round trajectory next to the training headline. Untimed wrt
+    # the training windows (runs after them); PARALLAX_BENCH_SERVE=0
+    # skips it.
+    serve_snap = None
+    if os.environ.get("PARALLAX_BENCH_SERVE", "1") != "0":
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from tools import loadgen
+            ssess, make_feed = loadgen.demo_session(
+                max_batch=8, length_buckets=(16, 32), dim=128, layers=2)
+            try:
+                load = loadgen.run_load(ssess, make_feed, 48,
+                                        concurrency=4)
+                stats = ssess.stats()
+            finally:
+                ssess.close()
+            occ = stats.get("serve.batch_occupancy") or {}
+            step = stats.get("serve.step_ms") or {}
+            serve_snap = {
+                "requests": load["submitted"],
+                "completed": load["completed"],
+                "qps": load["qps"],
+                "latency_ms": load["latency_ms"],
+                "recompiles": stats.get("serve.recompiles", 0),
+                "batch_occupancy_mean": round(occ.get("mean", 0), 3)
+                if occ else None,
+                "step_ms_p50": round(step.get("p50", 0), 3)
+                if step else None,
+            }
+        except Exception as e:
+            print(f"# serve bench failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
+    # Decode block (VERDICT r5 satellite): the cached-vs-cacheless NMT
+    # decode ratios (tools/nmt_decode_timing.py) — the serve-side
+    # latency primitive — tracked per round instead of a one-off perf
+    # file. PARALLAX_BENCH_DECODE=0 skips it.
+    decode_snap = None
+    if os.environ.get("PARALLAX_BENCH_DECODE", "1") != "0":
+        try:
+            from tools import nmt_decode_timing
+            d = nmt_decode_timing.measure(lengths=(32, 64), batch=4,
+                                          repeats=2)
+            decode_snap = {
+                "rows": d["rows"],
+                "ratio_grows_with_T": d["ratio_grows_with_T"],
+            }
+        except Exception as e:
+            print(f"# decode bench failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
     per_chip = hybrid_wps / n_chips
     # MFU: analytic matmul FLOPs per word (fwd+bwd) over the chip's
     # published bf16 peak — the judged utilization number (VERDICT r2
@@ -437,6 +502,27 @@ def worker_main():
         # shows zero executable misses and engine.recompiles == 0 in
         # the metrics snapshot above
         "compile": compile_snap or None,
+        # online serving (ISSUE 4): ServeSession QPS/latency under the
+        # loadgen mixed-length closed loop, recompiles (healthy: 0)
+        "serve": serve_snap,
+        # KV-cached vs cache-less decode ratios (the serve-side latency
+        # primitive), tracked per round
+        "decode": decode_snap,
+        # harness provenance (VERDICT r5 item 6): exactly what this
+        # number was measured with, so cross-round deltas are
+        # attributable when the bench harness itself changes — compare
+        # values only between rounds whose harness blocks match
+        "harness": {
+            "bench_sha256": _harness_hash(),
+            "steps_measured": steps,
+            "warmup_steps": warmup,
+            "batch_size": bs,
+            "seq_len": T,
+            "vocab_size": cfg.vocab_size,
+            "n_feed_batches": 4,
+            "baseline_batch_size": small_bs,
+            "baseline_steps": cmp_steps,
+        },
     }
     if wire.get("dense_allreduce_bytes"):
         # north-star secondary metric: sparse-grad bytes on wire per step

@@ -17,21 +17,22 @@ parallel_run) and the `ops` / `models` subpackages.
 from parallax_tpu.common.config import (CheckPointConfig,
                                         CommunicationConfig, Config,
                                         MPIConfig, ParallaxConfig, PSConfig,
-                                        ProfileConfig)
+                                        ProfileConfig, ServeConfig)
 from parallax_tpu.common.lib import parallax_log as log
 from parallax_tpu.core.engine import Model, TrainState
 from parallax_tpu.parallel.partitions import get_partitioner
 from parallax_tpu.runner import parallel_run
 from parallax_tpu.session import (Fetch, ParallaxSession, StepHandle,
                                   materialize)
-from parallax_tpu import compile, obs, ops, shard  # noqa: A004
+from parallax_tpu.serve import ServeSession
+from parallax_tpu import compile, obs, ops, serve, shard  # noqa: A004
 
 __version__ = "0.1.0"
 
 __all__ = [
     "get_partitioner", "parallel_run", "shard", "log", "Config",
     "ParallaxConfig", "PSConfig", "MPIConfig", "CommunicationConfig",
-    "CheckPointConfig", "ProfileConfig", "Model", "TrainState",
-    "ParallaxSession", "Fetch", "StepHandle", "materialize", "compile",
-    "obs", "ops",
+    "CheckPointConfig", "ProfileConfig", "ServeConfig", "Model",
+    "TrainState", "ParallaxSession", "Fetch", "StepHandle",
+    "materialize", "compile", "obs", "ops", "serve", "ServeSession",
 ]

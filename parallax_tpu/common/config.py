@@ -144,6 +144,93 @@ class ProfileConfig:
 
 
 @dataclasses.dataclass
+class ServeConfig:
+    """Online-serving knobs (``parallax_tpu.serve``, no reference
+    analogue — the reference is training-only).
+
+    * ``max_batch``: upper bound on requests fused into one device
+      batch; also the slot count of the continuous-decode scheduler.
+    * ``max_wait_ms``: batch-formation deadline — a partially filled
+      batch dispatches once the OLDEST waiting request has aged this
+      long (latency bound), instead of waiting for ``max_batch``
+      (throughput bound). 0 dispatches whatever is queued immediately.
+    * ``max_queue``: admission bound. A submit beyond this many waiting
+      requests is SHED (``ServeOverloaded`` raised to the caller,
+      ``serve.shed`` counted) — bounded memory and bounded worst-case
+      queueing delay instead of silent collapse under overload.
+    * ``default_deadline_ms``: per-request latency budget when the
+      caller doesn't pass one. A request whose deadline expires before
+      it is dispatched is dropped (``DeadlineExceeded`` on its future,
+      ``serve.timeouts`` counted) — never compute a result nobody is
+      waiting for. None = no deadline.
+    * ``batch_buckets``: declared batch sizes formed batches are padded
+      up to (the compile/ bucketing rule applied to serving); default
+      powers of two up to ``max_batch``. Together with
+      ``length_buckets`` this is the COMPLETE signature set the session
+      AOT-compiles at startup — live traffic never recompiles.
+    * ``length_buckets``: sequence-length buckets for ragged per-request
+      feeds (declared via ``ServeSession(ragged_feeds=...)``); each
+      request's ragged feeds are padded to the smallest bucket that
+      fits its longest one. None = requests must share fixed shapes.
+    * ``drain_timeout_s``: ``close()`` stops admission and serves the
+      already-accepted queue to completion, up to this long; whatever
+      is still queued after it is failed with ``ServeClosed``.
+    """
+
+    max_batch: int = 8
+    max_wait_ms: float = 5.0
+    max_queue: int = 128
+    default_deadline_ms: Optional[float] = None
+    batch_buckets: Optional[Sequence[int]] = None
+    length_buckets: Optional[Sequence[int]] = None
+    drain_timeout_s: float = 30.0
+
+    def __post_init__(self):
+        if int(self.max_batch) < 1:
+            raise ValueError(
+                f"serve max_batch must be >= 1, got {self.max_batch}")
+        if float(self.max_wait_ms) < 0:
+            raise ValueError(
+                f"serve max_wait_ms must be >= 0, got {self.max_wait_ms}")
+        if int(self.max_queue) < 1:
+            raise ValueError(
+                f"serve max_queue must be >= 1, got {self.max_queue}")
+        if self.default_deadline_ms is not None \
+                and float(self.default_deadline_ms) <= 0:
+            raise ValueError(
+                f"serve default_deadline_ms must be > 0, got "
+                f"{self.default_deadline_ms}")
+        for name in ("batch_buckets", "length_buckets"):
+            v = getattr(self, name)
+            if v is None:
+                continue
+            v = tuple(sorted({int(b) for b in v}))
+            if not v or any(b < 1 for b in v):
+                raise ValueError(
+                    f"serve {name} must be positive sizes, got "
+                    f"{getattr(self, name)!r}")
+            setattr(self, name, v)
+        if self.batch_buckets is not None \
+                and self.batch_buckets[-1] < int(self.max_batch):
+            raise ValueError(
+                f"serve batch_buckets {self.batch_buckets} do not cover "
+                f"max_batch={self.max_batch}; the largest bucket must "
+                f"fit a full batch")
+
+    def resolved_batch_buckets(self) -> tuple:
+        """Declared buckets, or doubling sizes 1,2,4,... up to (and
+        including) ``max_batch``."""
+        if self.batch_buckets is not None:
+            return tuple(self.batch_buckets)
+        out, b = [], 1
+        while b < int(self.max_batch):
+            out.append(b)
+            b *= 2
+        out.append(int(self.max_batch))
+        return tuple(out)
+
+
+@dataclasses.dataclass
 class ParallaxConfig:
     """Top-level config (reference: config.py:119-179).
 
@@ -276,6 +363,14 @@ class ParallaxConfig:
         default_factory=CheckPointConfig)
     profile_config: ProfileConfig = dataclasses.field(
         default_factory=ProfileConfig)
+    # -- online serving (serve/) -----------------------------------------
+    # Dynamic micro-batching / continuous-decode knobs for
+    # ``parallax_tpu.serve.ServeSession`` (batch formation under
+    # (max_batch, max_wait_ms), admission control + load shedding,
+    # per-request deadlines, the AOT-warmed signature set). See the
+    # ServeConfig docstring and docs/parallax_api.md "Serving".
+    serve_config: ServeConfig = dataclasses.field(
+        default_factory=ServeConfig)
 
     # Injected by parallel_run, mirroring the reference's set_sync /
     # set_resource_info setters (config.py:168-179).

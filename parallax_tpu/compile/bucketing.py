@@ -154,6 +154,32 @@ def bucket_batch(batch: Dict, buckets: Sequence[int],
     return out, bucket
 
 
+def length_bucket(n: int, buckets: Sequence[int]) -> Optional[int]:
+    """Smallest declared bucket >= ``n`` (None when nothing fits) —
+    the length analogue of ``bucket_batch``'s batch-dim rule, used by
+    the serving layer to pad ragged per-request sequence dims onto a
+    bounded signature set."""
+    return next((int(b) for b in sorted(buckets) if b >= n), None)
+
+
+def pad_axis0(a: np.ndarray, target: int, pad_value=0) -> np.ndarray:
+    """Pad ``a`` along axis 0 up to ``target`` rows with ``pad_value``
+    (unlike the batch-dim edge padding, sequence padding uses an
+    explicit pad token/value: models mask it via their own pad
+    semantics, e.g. NMT's PAD_ID -> src_valid). No-op when already
+    there; refuses to truncate."""
+    a = np.asarray(a)
+    n = a.shape[0]
+    if n == target:
+        return a
+    if n > target:
+        raise ValueError(
+            f"pad_axis0 cannot truncate: array has {n} rows, target "
+            f"{target}")
+    pad = np.full((target - n,) + a.shape[1:], pad_value, a.dtype)
+    return np.concatenate([a, pad], axis=0)
+
+
 def batch_signature(batch) -> Tuple:
     """The batch's shape/dtype signature — the jit retrace key.
 

@@ -858,63 +858,10 @@ class Engine:
             return self._shard_batch_impl(batch)
 
     def _shard_batch_impl(self, batch):
-        n = mesh_lib.num_devices(self.mesh)
-        overrides = self.model.batch_specs
-
-        multiprocess = jax.process_count() > 1
-        transforms = self.model.feed_transforms
-
-        def resolve(name, x):
-            """-> (host array, target sharding) for one feed leaf."""
-            x = np.asarray(x)
-            if name in transforms:
-                x = np.asarray(transforms[name](x, self.mesh))
-            if name in overrides:
-                spec = overrides[name]
-                # in multiprocess mode the caller feeds a process-local
-                # slice, so each dim's requirement shrinks by the process
-                # span of its axes
-                bad = spec_shape_mismatch(spec, x.shape, self.mesh,
-                                          local=multiprocess)
-                if bad is not None:
-                    dim, axes, need = bad
-                    raise ValueError(
-                        f"feed {name!r} dim {dim} of size "
-                        f"{x.shape[dim]} is not divisible by the "
-                        f"{need}-way (local) mesh axes {axes} in its "
-                        f"PartitionSpec; pad that dimension")
-                return x, NamedSharding(self.mesh, spec)
-            local_n = max(1, n // jax.process_count())
-            if x.ndim >= 1 and x.shape[0] % local_n != 0:
-                raise ValueError(
-                    f"batch dimension {x.shape[0]} is not divisible by the "
-                    f"{local_n} local devices of the mesh; pad the batch "
-                    f"(or feed per-replica lists of equal size)")
-            return x, self.batch_sharding_fn(x.ndim)
-
-        if isinstance(batch, dict):
-            resolved = {k: jax.tree.map(lambda x, k=k: resolve(k, x), v)
-                        for k, v in batch.items()}
-        else:
-            resolved = jax.tree.map(lambda x: resolve("", x), batch)
-        pairs_leaf = lambda v: (isinstance(v, tuple) and len(v) == 2
-                                and isinstance(v[1], NamedSharding))
-        if multiprocess:
-            # each host feeds its local slice of the global batch
-            # (reference: each worker's shard, shard.py semantics)
-            return jax.tree.map(
-                lambda v: jax.make_array_from_process_local_data(v[1],
-                                                                 v[0]),
-                resolved, is_leaf=pairs_leaf)
-        # one batched device_put for the whole feed dict: a single
-        # dispatch to the runtime instead of one host->device round
-        # trip per feed (the per-leaf form cost ~ms/step through a
-        # remote-tunnel backend)
-        flat, treedef = jax.tree_util.tree_flatten(resolved,
-                                                   is_leaf=pairs_leaf)
-        placed = jax.device_put([x for x, _ in flat],
-                                [s for _, s in flat])
-        return jax.tree_util.tree_unflatten(treedef, placed)
+        return place_host_batch(self.mesh, batch,
+                                overrides=self.model.batch_specs,
+                                transforms=self.model.feed_transforms,
+                                default_sharding_fn=self.batch_sharding_fn)
 
     def sparse_wire_bytes_per_step(self) -> Dict[str, int]:
         """Bytes-on-wire per step for the sparse path vs the dense
@@ -996,6 +943,80 @@ class Engine:
             parallax_log.info("exported compiled graph to %s", path)
         except Exception as e:  # non-fatal observability feature
             parallax_log.warning("graph export failed: %s", e)
+
+
+def place_host_batch(mesh: Mesh, batch,
+                     overrides: Optional[Dict[str, Any]] = None,
+                     transforms: Optional[Dict[str, Callable]] = None,
+                     default_sharding_fn: Optional[Callable] = None):
+    """Place a host feed pytree onto ``mesh`` — the one placement rule
+    shared by the training engine (``Engine.shard_batch``) and the
+    serving layer (serve/session.py): per-feed spec overrides, host-side
+    feed transforms, multi-host process-local assembly, and a single
+    batched ``device_put`` for the whole dict (one runtime dispatch
+    instead of one host->device round trip per feed).
+
+    ``default_sharding_fn(ndim) -> NamedSharding`` decides placement
+    for feeds without an override (the engine shards dim 0 over the
+    whole mesh; the serving layer replicates when a micro-batch bucket
+    doesn't divide the local devices)."""
+    overrides = overrides or {}
+    transforms = transforms or {}
+    n = mesh_lib.num_devices(mesh)
+    if default_sharding_fn is None:
+        default_sharding_fn = lambda ndim: NamedSharding(  # noqa: E731
+            mesh, mesh_lib.batch_spec(ndim))
+    multiprocess = jax.process_count() > 1
+
+    def resolve(name, x):
+        """-> (host array, target sharding) for one feed leaf."""
+        x = np.asarray(x)
+        if name in transforms:
+            x = np.asarray(transforms[name](x, mesh))
+        if name in overrides:
+            spec = overrides[name]
+            # in multiprocess mode the caller feeds a process-local
+            # slice, so each dim's requirement shrinks by the process
+            # span of its axes
+            bad = spec_shape_mismatch(spec, x.shape, mesh,
+                                      local=multiprocess)
+            if bad is not None:
+                dim, axes, need = bad
+                raise ValueError(
+                    f"feed {name!r} dim {dim} of size "
+                    f"{x.shape[dim]} is not divisible by the "
+                    f"{need}-way (local) mesh axes {axes} in its "
+                    f"PartitionSpec; pad that dimension")
+            return x, NamedSharding(mesh, spec)
+        sharding = default_sharding_fn(x.ndim)
+        if sharding.spec and sharding.spec[0] is not None:
+            local_n = max(1, n // jax.process_count())
+            if x.ndim >= 1 and x.shape[0] % local_n != 0:
+                raise ValueError(
+                    f"batch dimension {x.shape[0]} is not divisible by "
+                    f"the {local_n} local devices of the mesh; pad the "
+                    f"batch (or feed per-replica lists of equal size)")
+        return x, sharding
+
+    if isinstance(batch, dict):
+        resolved = {k: jax.tree.map(lambda x, k=k: resolve(k, x), v)
+                    for k, v in batch.items()}
+    else:
+        resolved = jax.tree.map(lambda x: resolve("", x), batch)
+    pairs_leaf = lambda v: (isinstance(v, tuple) and len(v) == 2
+                            and isinstance(v[1], NamedSharding))
+    if multiprocess:
+        # each host feeds its local slice of the global batch
+        # (reference: each worker's shard, shard.py semantics)
+        return jax.tree.map(
+            lambda v: jax.make_array_from_process_local_data(v[1],
+                                                             v[0]),
+            resolved, is_leaf=pairs_leaf)
+    flat, treedef = jax.tree_util.tree_flatten(resolved,
+                                               is_leaf=pairs_leaf)
+    placed = jax.device_put([x for x, _ in flat],
+                            [s for _, s in flat])
+    return jax.tree_util.tree_unflatten(treedef, placed)
 
 
 def _process_span(mesh: Mesh, axis: str) -> int:

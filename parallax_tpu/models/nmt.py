@@ -288,6 +288,50 @@ def _decode_step_cached(cfg, params, tok, t, kc, vc, ck, cv, src_valid):
     return emb_ops.mask_padded_logits(logits, cfg.vocab_size), kc, vc
 
 
+def _decode_step_cached_multi(cfg, params, tok, t, kc, vc, ck, cv,
+                              src_valid):
+    """Per-slot-position variant of ``_decode_step_cached`` for the
+    serving layer's continuous scheduler (serve/continuous.py): ``tok``
+    [S] holds each slot's current token and ``t`` [S] its OWN decode
+    position, so sequences at different depths decode in one batched
+    dispatch. Row-wise math identical to the scalar-``t`` step — every
+    op (projections, per-slot-masked attention, layer norms) treats
+    slots independently, so a slot's tokens are bit-identical to
+    decoding its request alone (tested: tests/test_serve.py)."""
+    dt = cfg.compute_dtype
+    D = cfg.model_dim
+    T = kc.shape[2]
+    S = tok.shape[0]
+    rows = jnp.arange(S)
+    pos_t = jnp.take(params["pos"].astype(dt), t, axis=0)       # [S, D]
+    x = (emb_ops.embedding_lookup(params["emb"], tok[:, None]).astype(dt)
+         * jnp.asarray(np.sqrt(D), dt) + pos_t[:, None])       # [S, 1, D]
+    # per-slot causal mask over the cache buffer; built once
+    self_mask = (jnp.arange(T)[None, :] <= t[:, None])[:, None, None, :]
+    for i, p in enumerate(params["dec"]):
+        a = p["attn"]
+        q = x @ a["wq"].astype(dt)
+        k_t = x @ a["wk"].astype(dt)
+        v_t = x @ a["wv"].astype(dt)
+        kc = kc.at[i, rows, t].set(k_t[:, 0])
+        vc = vc.at[i, rows, t].set(v_t[:, 0])
+        y = _attention(q, kc[i], vc[i], self_mask, cfg.num_heads)
+        x = _layer_norm(x + y @ a["wo"].astype(dt),
+                        p["ln1"]["s"].astype(dt), p["ln1"]["b"].astype(dt))
+        c = p["cross"]
+        qc = x @ c["wq"].astype(dt)
+        yc = _attention(qc, ck[i], cv[i], src_valid[:, None, None, :],
+                        cfg.num_heads)
+        x = _layer_norm(x + yc @ c["wo"].astype(dt),
+                        p["ln3"]["s"].astype(dt), p["ln3"]["b"].astype(dt))
+        m = p["mlp"]
+        y2 = jax.nn.relu(x @ m["w1"].astype(dt)) @ m["w2"].astype(dt)
+        x = _layer_norm(x + y2,
+                        p["ln2"]["s"].astype(dt), p["ln2"]["b"].astype(dt))
+    logits = x[:, 0].astype(jnp.float32) @ params["out_proj"]
+    return emb_ops.mask_padded_logits(logits, cfg.vocab_size), kc, vc
+
+
 def build_model(cfg: NMTConfig) -> Model:
     V, D = cfg.padded_vocab, cfg.model_dim
     if cfg.tensor_parallel and cfg.use_pallas_attention:

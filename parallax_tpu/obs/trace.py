@@ -227,6 +227,26 @@ def span(name: str, **attrs):
     return _Span(name, attrs or None)
 
 
+def record_span(name: str, start: float, end: float, **attrs) -> None:
+    """Record an already-timed region (``perf_counter()`` endpoints).
+
+    The context-manager form can only time a region that opens and
+    closes on one thread; a serving request's lifetime spans the client
+    thread (enqueue) and the batcher/scheduler thread (completion), so
+    the completing thread records the whole interval after the fact.
+    """
+    if not _state.enabled:
+        return
+    tid = threading.get_ident()
+    tname = getattr(_thread_name_cache, "name", None)
+    if tname is None:
+        tname = threading.current_thread().name
+        _thread_name_cache.name = tname
+    _collector.record(TraceEvent(name, start - _EPOCH,
+                                 max(0.0, end - start), tid, tname,
+                                 attrs or None))
+
+
 def export_chrome_trace(path: str) -> str:
     """Export the process-wide collector to ``path``."""
     return _collector.export_chrome_trace(path)

@@ -1,0 +1,44 @@
+"""parallax_tpu.serve — the online serving subsystem (ISSUE 4).
+
+Everything before this package served the *training* step; this is the
+request path of the ROADMAP north star ("serving heavy traffic"):
+
+  * :class:`~parallax_tpu.serve.session.ServeSession` — one object
+    owning planning (the engine's mesh/partition machinery), an
+    AOT-warmed closed signature set (the ``compile/`` bucketing
+    discipline applied to serving), the request queue, and teardown
+    with graceful drain.
+  * :mod:`~parallax_tpu.serve.batcher` — dynamic micro-batching:
+    bounded queue with per-request deadlines, batch formation under
+    ``(max_batch, max_wait_ms)``, admission control with load
+    shedding (Clipper-style deadline batching).
+  * :mod:`~parallax_tpu.serve.continuous` — the slot-based continuous
+    decode scheduler over a KV-cached step: finished sequences retire
+    and free slots refill mid-flight instead of waiting for the
+    batch's slowest member (Orca-style continuous batching).
+  * :mod:`~parallax_tpu.serve.adapters` — DecodeProgram bindings for
+    the repo's models (NMT greedy decode).
+
+Knobs live on ``Config(serve_config=ServeConfig(...))``; ``serve.*``
+metrics and per-request spans land in ``obs/``;
+``tools/check_serve_slo.py`` enforces the serving SLO contract (zero
+serve-time recompiles, deadline discipline, batcher overhead <= 5% of
+step wall-time) in tier-1.
+"""
+
+from parallax_tpu.common.config import ServeConfig
+from parallax_tpu.serve.adapters import NMTDecodeProgram
+from parallax_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
+                                        Request, RequestQueue,
+                                        ServeClosed, ServeError,
+                                        ServeOverloaded)
+from parallax_tpu.serve.continuous import (ContinuousScheduler,
+                                           DecodeProgram)
+from parallax_tpu.serve.session import ServeSession
+
+__all__ = [
+    "ServeSession", "ServeConfig", "Request", "RequestQueue",
+    "MicroBatcher", "ContinuousScheduler", "DecodeProgram",
+    "NMTDecodeProgram", "ServeError", "ServeOverloaded",
+    "DeadlineExceeded", "ServeClosed",
+]

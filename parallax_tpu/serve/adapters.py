@@ -1,0 +1,124 @@
+"""Model adapters: DecodeProgram implementations over existing models.
+
+The continuous scheduler (serve/continuous.py) is model-agnostic; an
+adapter binds it to one model family's prefill/step math. The NMT
+adapter below reuses models/nmt.py's encoder, cross-attention K/V
+precompute and the per-slot-position cached decoder step — the exact
+KV-cached math ``greedy_decode`` runs, restructured from "one
+fori_loop per batch" into "one step per scheduler iteration".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parallax_tpu.compile import bucketing
+from parallax_tpu.models import nmt
+from parallax_tpu.serve.continuous import DecodeProgram
+
+
+class NMTDecodeProgram(DecodeProgram):
+    """Greedy KV-cached NMT decoding for the continuous scheduler.
+
+    ``max_src_len`` fixes the prefill signature: every request's
+    ``src`` is padded to it with PAD (the encoder's ``src_valid`` mask
+    makes padded positions inert — real-position encodings are
+    bit-identical to the unpadded encode). ``max_len`` fixes the
+    decode buffer ``T`` (the per-request token cap).
+
+    State layout per slot set ``S``: cross K/V ``[L, S, Ts, D]``
+    written at prefill, self K/V caches ``[L, S, T, D]`` written one
+    position per step, ``src_valid [S, Ts]``. A freed slot's stale
+    cache needs no zeroing — positions beyond a slot's own ``t`` are
+    masked, and every position ``<= t`` is freshly written after a
+    refill.
+    """
+
+    def __init__(self, cfg: nmt.NMTConfig, max_src_len: int,
+                 max_len: Optional[int] = None):
+        self.cfg = cfg
+        self.Ts = int(max_src_len)
+        self.max_len = int(max_len or cfg.max_len)
+        if self.max_len > cfg.max_len:
+            raise ValueError(
+                f"max_len={max_len} exceeds the model's positional "
+                f"table ({cfg.max_len})")
+        if self.Ts > cfg.max_len:
+            raise ValueError(
+                f"max_src_len={max_src_len} exceeds the model's "
+                f"positional table ({cfg.max_len})")
+        self.bos_id = nmt.BOS_ID
+        self.eos_id = nmt.EOS_ID
+        self.pad_id = nmt.PAD_ID
+        self._prefill_jit = jax.jit(self._prefill)
+        self._insert_jit = jax.jit(self._insert)
+        self._step_jit = jax.jit(self._step)
+
+    # -- feed contract -----------------------------------------------------
+
+    def example_feed(self) -> Dict[str, np.ndarray]:
+        return {"src": np.full((self.Ts,), self.pad_id, np.int32)}
+
+    def prepare_feed(self, feed: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        src = np.asarray(feed["src"], np.int32)
+        if src.ndim != 1:
+            raise ValueError(
+                f"decode feed 'src' must be one request's [T] token "
+                f"row, got shape {src.shape}")
+        if src.shape[0] > self.Ts:
+            raise ValueError(
+                f"src length {src.shape[0]} exceeds max_src_len "
+                f"{self.Ts}")
+        return {"src": bucketing.pad_axis0(src, self.Ts, self.pad_id)}
+
+    # -- device programs (each jitted once; fixed shapes) ------------------
+
+    def init_state(self, params, slots: int) -> Dict[str, jax.Array]:
+        cfg = self.cfg
+        L, D, dt = cfg.num_layers, cfg.model_dim, cfg.compute_dtype
+        z_cross = jnp.zeros((L, slots, self.Ts, D), dt)
+        z_self = jnp.zeros((L, slots, self.max_len, D), dt)
+        return {"ck": z_cross, "cv": z_cross,
+                "kc": z_self, "vc": z_self,
+                "src_valid": jnp.zeros((slots, self.Ts), bool)}
+
+    def prefill(self, params, feed):
+        return self._prefill_jit(params, feed)
+
+    def _prefill(self, params, feed):
+        src = feed["src"][None]                              # [1, Ts]
+        enc_out, src_valid = nmt._encode(self.cfg, params, src)
+        ck, cv = nmt._cross_kv(self.cfg, params, enc_out)    # [L,1,Ts,D]
+        return {"ck": ck, "cv": cv, "src_valid": src_valid}
+
+    def insert(self, state, slot, request_state):
+        return self._insert_jit(state, slot, request_state)
+
+    def _insert(self, state, slot, rs):
+        out = dict(state)
+        out["ck"] = jax.lax.dynamic_update_slice(
+            state["ck"], rs["ck"], (0, slot, 0, 0))
+        out["cv"] = jax.lax.dynamic_update_slice(
+            state["cv"], rs["cv"], (0, slot, 0, 0))
+        out["src_valid"] = jax.lax.dynamic_update_slice(
+            state["src_valid"], rs["src_valid"], (slot, 0))
+        return out
+
+    def step(self, params, state, tok, t):
+        return self._step_jit(params, state, tok, t)
+
+    def _step(self, params, state, tok, t):
+        logits, kc, vc = nmt._decode_step_cached_multi(
+            self.cfg, params, tok, t, state["kc"], state["vc"],
+            state["ck"], state["cv"], state["src_valid"])
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out = dict(state)
+        out["kc"], out["vc"] = kc, vc
+        return nxt, out
+
+
+__all__ = ["NMTDecodeProgram"]

@@ -1,0 +1,310 @@
+"""Dynamic micro-batching: request futures, admission control, batch
+formation.
+
+The serving data plane (Clipper-style deadline batching, PAPERS.md):
+clients submit single-example requests; a batcher thread fuses them
+into device batches under two bounds — ``max_batch`` (throughput: a
+full batch dispatches immediately) and ``max_wait_ms`` (latency: a
+partial batch dispatches once its OLDEST request has waited that
+long). Admission control keeps the system stable under overload:
+
+* a bounded queue (``max_queue``) — a submit beyond it is SHED with
+  :class:`ServeOverloaded` raised synchronously to the caller, so
+  overload produces fast failures instead of unbounded queueing delay;
+* per-request deadlines — a request whose deadline expires while it
+  waits is dropped (:class:`DeadlineExceeded` delivered through its
+  future) rather than computed for a caller who already gave up.
+
+Batches are formed per *group key* (the padded example signature the
+session computes at submit time): requests in one device batch must
+share a shape signature, and FIFO order picks the group — the group of
+the oldest waiting request forms first, so no signature starves.
+
+``close()`` drains: admission stops, the already-accepted queue is
+served to completion (partial batches dispatch immediately — no
+``max_wait`` stalling during drain), and anything still queued after
+``drain_timeout_s`` fails with :class:`ServeClosed`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.obs import trace
+
+
+class ServeError(RuntimeError):
+    """Base class of serving-layer request failures."""
+
+
+class ServeOverloaded(ServeError):
+    """Admission control shed this request (queue at ``max_queue``)."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline expired before it was served."""
+
+
+class ServeClosed(ServeError):
+    """The session closed before this request could be served."""
+
+
+_req_ids = itertools.count()
+
+
+class Request:
+    """One submitted request: the feed plus a future for its result.
+
+    ``result()`` blocks until the batcher completes or fails the
+    request (re-raising the failure); ``done()`` never blocks. Times
+    are ``time.perf_counter()`` seconds: ``t_enqueue`` at submit,
+    ``deadline`` absolute (None = no deadline), ``t_done`` when the
+    result (or failure) landed.
+    """
+
+    __slots__ = ("id", "feed", "deadline", "group_key", "max_new_tokens",
+                 "t_enqueue", "t_done", "t_first_token", "_event",
+                 "_result", "_error")
+
+    def __init__(self, feed: Dict[str, Any],
+                 deadline: Optional[float] = None,
+                 group_key: Any = None,
+                 max_new_tokens: Optional[int] = None):
+        self.id = next(_req_ids)
+        self.feed = feed
+        self.deadline = deadline
+        self.group_key = group_key
+        self.max_new_tokens = max_new_tokens
+        self.t_enqueue = time.perf_counter()
+        self.t_done: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id} not done within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def error(self) -> Optional[BaseException]:
+        """The failure, if the request failed (non-blocking)."""
+        return self._error if self._event.is_set() else None
+
+    def latency_s(self) -> Optional[float]:
+        return (None if self.t_done is None
+                else self.t_done - self.t_enqueue)
+
+    def _complete(self, result) -> None:
+        self.t_done = time.perf_counter()
+        self._result = result
+        self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self.t_done = time.perf_counter()
+        self._error = exc
+        self._event.set()
+
+
+class RequestQueue:
+    """Bounded FIFO with deadline shedding and group-aware batch
+    formation; shared by the one-shot micro-batcher and the
+    continuous-decode scheduler."""
+
+    def __init__(self, max_queue: int, metrics=None):
+        self.max_queue = int(max_queue)
+        self._items: List[Request] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._metrics = metrics
+        self._depth = (metrics.gauge("serve.queue_depth")
+                       if metrics is not None else None)
+        self._timeouts = (metrics.counter("serve.timeouts")
+                          if metrics is not None else None)
+        self._shed = (metrics.counter("serve.shed")
+                      if metrics is not None else None)
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._items)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _set_depth_locked(self) -> None:
+        if self._depth is not None:
+            self._depth.set(len(self._items))
+
+    def put(self, req: Request) -> None:
+        """Admit one request; raises :class:`ServeOverloaded` (counted
+        as ``serve.shed``) when the queue is at ``max_queue`` and
+        :class:`ServeClosed` after ``close()``."""
+        with self._cond:
+            if self._closed:
+                raise ServeClosed("serve session is closed to new "
+                                  "requests")
+            if len(self._items) >= self.max_queue:
+                if self._shed is not None:
+                    self._shed.inc()
+                raise ServeOverloaded(
+                    f"request queue at max_queue={self.max_queue}; "
+                    f"request shed")
+            self._items.append(req)
+            self._set_depth_locked()
+            self._cond.notify_all()
+
+    def _shed_expired_locked(self, now: float) -> None:
+        kept = []
+        for r in self._items:
+            if r.deadline is not None and now > r.deadline:
+                if self._timeouts is not None:
+                    self._timeouts.inc()
+                r._fail(DeadlineExceeded(
+                    f"request {r.id} deadline expired after "
+                    f"{now - r.t_enqueue:.3f}s in queue"))
+            else:
+                kept.append(r)
+        self._items = kept
+        self._set_depth_locked()
+
+    def pop(self, timeout: float = 0.05) -> Optional[Request]:
+        """Oldest non-expired request, or None after ``timeout`` (also
+        None immediately when closed and empty)."""
+        end = time.perf_counter() + timeout
+        with self._cond:
+            while True:
+                now = time.perf_counter()
+                self._shed_expired_locked(now)
+                if self._items:
+                    req = self._items.pop(0)
+                    self._set_depth_locked()
+                    return req
+                if self._closed or now >= end:
+                    return None
+                self._cond.wait(min(0.02, max(0.0, end - now)))
+
+    def form_group(self, max_n: int, max_wait_s: float,
+                   stop: threading.Event,
+                   poll_s: float = 0.05) -> List[Request]:
+        """Form one batch: up to ``max_n`` requests sharing the OLDEST
+        waiting request's ``group_key``, dispatched as soon as the
+        group is full, the oldest member has waited ``max_wait_s``, or
+        the queue is draining (closed). Returns [] when there is
+        nothing to serve yet (caller loops)."""
+        with self._cond:
+            now = time.perf_counter()
+            self._shed_expired_locked(now)
+            if not self._items:
+                if not (self._closed or stop.is_set()):
+                    self._cond.wait(poll_s)
+                    self._shed_expired_locked(time.perf_counter())
+                if not self._items:
+                    return []
+            key = self._items[0].group_key
+            dispatch_at = self._items[0].t_enqueue + max_wait_s
+        while True:
+            with self._cond:
+                now = time.perf_counter()
+                self._shed_expired_locked(now)
+                matching = [r for r in self._items if r.group_key == key]
+                full = len(matching) >= max_n
+                due = now >= dispatch_at
+                if full or due or self._closed or stop.is_set():
+                    take = matching[:max_n]
+                    for r in take:
+                        self._items.remove(r)
+                    self._set_depth_locked()
+                    return take
+                self._cond.wait(
+                    min(poll_s, max(0.001, dispatch_at - now)))
+
+    def close(self) -> None:
+        """Stop admission; queued requests stay servable (drain)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def fail_all(self, exc: BaseException) -> int:
+        """Fail every still-queued request (end of drain); returns the
+        count failed."""
+        with self._cond:
+            items, self._items = self._items, []
+            self._set_depth_locked()
+        for r in items:
+            r._fail(exc)
+        return len(items)
+
+
+class MicroBatcher:
+    """The one-shot dispatch loop: forms batches off a
+    :class:`RequestQueue` and hands them to ``run_batch(requests)``
+    (the session's pad-place-infer-split callback) on a dedicated
+    daemon thread. A ``run_batch`` failure fails exactly that batch's
+    requests — the loop (and every other request) survives."""
+
+    def __init__(self, queue: RequestQueue, run_batch: Callable,
+                 max_batch: int, max_wait_ms: float,
+                 name: str = "parallax-serve-batcher"):
+        self._queue = queue
+        self._run_batch = run_batch
+        self._max_batch = int(max_batch)
+        self._max_wait_s = float(max_wait_ms) / 1e3
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            if self._stop.is_set():
+                return
+            batch = self._queue.form_group(self._max_batch,
+                                           self._max_wait_s, self._stop)
+            if batch:
+                if self._stop.is_set():
+                    # fast close (no drain): stop arrived while the
+                    # group formed — these requests are FAILED, not
+                    # served, matching the documented close contract
+                    for r in batch:
+                        r._fail(ServeClosed(
+                            "session closed without drain"))
+                    continue
+                try:
+                    with trace.span("serve.batch", n=len(batch)):
+                        self._run_batch(batch)
+                except BaseException as e:  # fail the batch, not the loop
+                    parallax_log.warning(
+                        "serve batch of %d request(s) failed: %s",
+                        len(batch), e)
+                    for r in batch:
+                        if not r.done():
+                            r._fail(e if isinstance(e, Exception)
+                                    else ServeError(str(e)))
+                continue
+            if self._queue.closed and len(self._queue) == 0:
+                return
+
+    def drain(self, timeout_s: float) -> None:
+        """Wait for the loop to serve the closed queue to completion
+        (call after ``queue.close()``); hard-stops at the timeout —
+        with ``timeout_s=0`` (close without drain) the loop fails
+        still-queued requests instead of serving them."""
+        if timeout_s > 0:
+            self._thread.join(timeout=timeout_s)
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        if self._thread.is_alive():
+            parallax_log.warning(
+                "serve batcher thread did not stop within the drain "
+                "window; undrained requests will be failed by close()")

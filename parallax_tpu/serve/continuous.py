@@ -1,0 +1,301 @@
+"""Slot-based continuous decoding (Orca-style, PAPERS.md).
+
+Static batching decodes a batch until its SLOWEST sequence finishes:
+a 5-token reply waits for the 120-token one next to it, and the batch
+slot it occupies does nothing in between. The continuous scheduler
+keeps a fixed set of ``max_batch`` *slots* over one compiled KV-cached
+decode step and treats membership as dynamic:
+
+* every iteration runs ONE batched step for all slots (one signature,
+  one executable — the step function takes per-slot positions, so
+  slots at different depths coexist in one dispatch);
+* a slot whose sequence just emitted EOS (or hit its token budget, or
+  blew its deadline) RETIRES immediately — its request completes now,
+  not when the batch's slowest member finishes;
+* the freed slot REFILLS from the request queue on the next iteration
+  (a single-request prefill writes the newcomer's encoder state into
+  the slot) — the batch never flushes, occupancy stays high under
+  load.
+
+Correctness rides on per-slot independence: every per-token op
+(projections, attention with per-slot position masks, layer norms,
+argmax) is row-wise, so a slot's tokens are bit-identical to decoding
+its request alone — tested against per-request standalone decode in
+tests/test_serve.py.
+
+The model plugs in as a :class:`DecodeProgram` (duck-typed; see
+serve/adapters.py for the NMT implementation): fixed-shape
+``init_state`` / ``prefill`` / ``insert`` / ``step`` callables the
+scheduler drives. All four are warmed at construction, so serving
+never meets an XLA compile.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.obs import trace
+from parallax_tpu.serve.batcher import (DeadlineExceeded, Request,
+                                        RequestQueue)
+
+
+class DecodeProgram:
+    """The interface a decode model exposes to the scheduler (duck
+    typed — subclassing is optional; serve/adapters.py implements it
+    for NMT). All shapes are FIXED per program instance so the whole
+    serving loop runs on a closed signature set.
+
+    Attributes: ``max_len`` (decode buffer length — the per-request
+    token cap), ``bos_id`` / ``eos_id`` / ``pad_id``.
+
+    * ``example_feed() -> dict`` — one request's feed at the padded
+      shapes ``prefill`` accepts (used for warmup and planning).
+    * ``prepare_feed(feed) -> dict`` — validate/pad one request's raw
+      feed onto the fixed prefill shapes.
+    * ``init_state(params, slots) -> state`` — fresh device state for
+      ``slots`` slots (KV caches, encoder memory, masks).
+    * ``prefill(params, feed) -> request_state`` — run the one-time
+      per-request work (e.g. the encoder + cross-attention K/V) for a
+      single request.
+    * ``insert(state, slot, request_state) -> state`` — write one
+      prefilled request into slot ``slot`` (an int32 scalar; traced,
+      so any slot index shares one compiled insert).
+    * ``step(params, state, tok, t) -> (next_tok, state)`` — one
+      batched decode step: ``tok``/``t`` are ``[slots]`` int32 arrays
+      of each slot's current token and position; returns each slot's
+      next token. Inactive slots' lanes compute garbage the scheduler
+      ignores — they must not affect other lanes (row-wise ops only).
+    """
+
+
+class _Slot:
+    __slots__ = ("req", "tokens", "t", "cap")
+
+    def __init__(self, req: Request, cap: int):
+        self.req = req
+        self.tokens: List[int] = []
+        self.t = 0
+        self.cap = cap
+
+
+class ContinuousScheduler:
+    """Drives one :class:`DecodeProgram` over a request queue on a
+    daemon thread; constructed (and owned) by
+    :class:`~parallax_tpu.serve.session.ServeSession`."""
+
+    TOKENS_PER_SEC_WINDOW = 50
+
+    def __init__(self, program, params, serve_config, metrics,
+                 queue: RequestQueue,
+                 name: str = "parallax-serve-decode"):
+        self._program = program
+        self._params = params
+        self._sc = serve_config
+        self._queue = queue
+        self.metrics = metrics
+        self._S = int(serve_config.max_batch)
+        self._ttft = metrics.histogram("serve.ttft_ms")
+        self._latency = metrics.histogram("serve.request_latency_ms")
+        self._occupancy = metrics.histogram("serve.batch_occupancy")
+        self._step_ms = metrics.histogram("serve.step_ms")
+        self._tokens = metrics.counter("serve.tokens")
+        self._completed = metrics.counter("serve.completed")
+        self._timeouts = metrics.counter("serve.timeouts")
+        self._steps = metrics.counter("serve.decode_steps")
+        self._tok_times: collections.deque = collections.deque(
+            maxlen=self.TOKENS_PER_SEC_WINDOW)
+        metrics.gauge("serve.tokens_per_sec").set_fn(self.tokens_per_sec)
+        self._slots: List[Optional[_Slot]] = [None] * self._S
+        self._tok = np.full((self._S,), program.pad_id, np.int32)
+        self._t = np.zeros((self._S,), np.int32)
+        self._stop = threading.Event()
+        self._kick = threading.Event()
+        self._warm()
+        self._state = program.init_state(params, self._S)
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- warmup ------------------------------------------------------------
+
+    def _warm(self) -> None:
+        """Execute prefill / insert / step once on dummy inputs so
+        their single signatures are compiled before serving (the state
+        this writes is discarded — a fresh one is built after)."""
+        prog, params = self._program, self._params
+        t0 = time.perf_counter()
+        with trace.span("serve.warmup_compile", mode="decode"):
+            state = prog.init_state(params, self._S)
+            rs = prog.prefill(params,
+                              prog.prepare_feed(prog.example_feed()))
+            state = prog.insert(state, np.int32(0), rs)
+            tok = np.full((self._S,), prog.bos_id, np.int32)
+            nxt, state = prog.step(params, state, tok,
+                                   np.zeros((self._S,), np.int32))
+            jax.block_until_ready(nxt)
+        dt = time.perf_counter() - t0
+        self.metrics.histogram("serve.compile_seconds").record(dt)
+        parallax_log.info(
+            "serve decode warmup: prefill/insert/step compiled in "
+            "%.2fs (%d slots)", dt, self._S)
+
+    # -- admission hooks (called by ServeSession) --------------------------
+
+    def make_request(self, feed, deadline,
+                     max_new_tokens: Optional[int]) -> Request:
+        prog = self._program
+        cap = int(max_new_tokens or prog.max_len)
+        if cap < 1 or cap > prog.max_len:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} outside [1, "
+                f"{prog.max_len}] (the program's decode buffer)")
+        return Request(prog.prepare_feed(feed), deadline=deadline,
+                       max_new_tokens=cap)
+
+    def kick(self) -> None:
+        self._kick.set()
+
+    def tokens_per_sec(self) -> Optional[float]:
+        window = list(self._tok_times)
+        if len(window) < 2:
+            return None
+        dt = window[-1][0] - window[0][0]
+        n = sum(c for _, c in window[1:])
+        return n / dt if dt > 0 else None
+
+    # -- the scheduling loop ----------------------------------------------
+
+    def _active(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    def _refill(self) -> None:
+        """Fill free slots from the queue: one single-request prefill
+        each, inserted without touching the running slots."""
+        for j in range(self._S):
+            if self._slots[j] is not None:
+                continue
+            req = self._queue.pop(timeout=0.0)
+            if req is None:
+                return
+            with trace.span("serve.prefill", slot=j, id=req.id):
+                rs = self._program.prefill(self._params, req.feed)
+                self._state = self._program.insert(
+                    self._state, np.int32(j), rs)
+            self._slots[j] = _Slot(req, req.max_new_tokens)
+            self._tok[j] = self._program.bos_id
+            self._t[j] = 0
+
+    def _retire(self, j: int, now: float) -> None:
+        slot = self._slots[j]
+        self._slots[j] = None
+        self._tok[j] = self._program.pad_id
+        self._t[j] = 0
+        req = slot.req
+        req._complete(np.asarray(slot.tokens, np.int32))
+        self._completed.inc()
+        self._latency.record((now - req.t_enqueue) * 1e3)
+        trace.record_span("serve.request", req.t_enqueue, now,
+                          id=req.id, tokens=len(slot.tokens))
+
+    def _expire_slots(self, now: float) -> None:
+        for j, slot in enumerate(self._slots):
+            if slot is None or slot.req.deadline is None:
+                continue
+            if now > slot.req.deadline:
+                self._slots[j] = None
+                self._tok[j] = self._program.pad_id
+                self._t[j] = 0
+                self._timeouts.inc()
+                slot.req._fail(DeadlineExceeded(
+                    f"request {slot.req.id} deadline expired mid-"
+                    f"decode after {len(slot.tokens)} token(s)"))
+
+    def _fail_active(self, exc) -> None:
+        """Fail every in-flight slot — called ONLY from the scheduler
+        thread (slot state is single-owner; a cross-thread mutation
+        here would race the decode loop)."""
+        for j, slot in enumerate(self._slots):
+            if slot is not None:
+                self._slots[j] = None
+                self._tok[j] = self._program.pad_id
+                self._t[j] = 0
+                slot.req._fail(exc)
+
+    def _loop(self) -> None:
+        from parallax_tpu.serve.batcher import ServeClosed
+        prog = self._program
+        while True:
+            if self._stop.is_set():
+                # fast close / drain window expired: in-flight decodes
+                # are failed by THIS thread (single-owner slot state)
+                self._fail_active(ServeClosed(
+                    "session closed mid-decode"))
+                return
+            now = time.perf_counter()
+            self._expire_slots(now)
+            self._refill()
+            n_active = self._active()
+            if n_active == 0:
+                if self._queue.closed and len(self._queue) == 0:
+                    return
+                self._kick.wait(0.02)
+                self._kick.clear()
+                continue
+            t0 = time.perf_counter()
+            with trace.span("serve.step", active=n_active):
+                nxt, self._state = prog.step(self._params, self._state,
+                                             self._tok, self._t)
+                nxt = np.asarray(nxt)  # block: tokens ready
+            now = time.perf_counter()
+            self._step_ms.record((now - t0) * 1e3)
+            self._steps.inc()
+            self._occupancy.record(n_active / self._S)
+            emitted = 0
+            for j, slot in enumerate(self._slots):
+                if slot is None:
+                    continue
+                token = int(nxt[j])
+                if slot.req.t_first_token is None:
+                    slot.req.t_first_token = now
+                    self._ttft.record((now - slot.req.t_enqueue) * 1e3)
+                slot.tokens.append(token)
+                emitted += 1
+                slot.t += 1
+                self._tok[j] = token
+                self._t[j] = slot.t
+                if token == prog.eos_id or len(slot.tokens) >= slot.cap:
+                    self._retire(j, now)
+            self._tokens.inc(emitted)
+            self._tok_times.append((now, emitted))
+
+    def drain(self, timeout_s: float) -> None:
+        """After ``queue.close()``: wait for in-flight + queued decodes
+        to finish, hard-stopping at the timeout. Slot state is owned by
+        the scheduler thread — undrained slots are failed by the loop
+        itself when it observes the stop flag, never from here."""
+        if timeout_s > 0:
+            self._thread.join(timeout=timeout_s)
+        self._stop.set()
+        self._kick.set()
+        self._thread.join(timeout=10.0)
+        if self._thread.is_alive():
+            parallax_log.warning(
+                "serve decode thread did not stop within the drain "
+                "window; in-flight requests may hang until their "
+                "result() timeout")
+        # unhook the gauge: its set_fn pins this scheduler (and the
+        # device KV caches) inside a possibly long-lived shared
+        # registry; after close it must read as plain None, not sample
+        # a dead scheduler
+        self.metrics.gauge("serve.tokens_per_sec").set_fn(None)
+        self._state = None
+
+
+__all__ = ["DecodeProgram", "ContinuousScheduler"]

@@ -1,0 +1,462 @@
+"""ServeSession — put a model behind a request queue.
+
+The training side (session.py / core/engine.py) optimizes steps/sec of
+one long-lived loop; this is the other half of the ROADMAP north star:
+many small independent requests, each with its own latency budget.
+One object owns the whole serving stack:
+
+* **planning** — the inference fn is jitted over the same
+  ``('repl','shard')`` mesh the engine trains on; with a ``Model``
+  given, parameter placement comes from the engine's own
+  :func:`~parallax_tpu.core.engine.build_plan` (row-sharded embedding
+  tables, replicated dense — the training layout carried into
+  serving); otherwise parameters replicate (the standard serving
+  layout). Batch placement reuses
+  :func:`~parallax_tpu.core.engine.place_host_batch`.
+* **a bounded signature set** — requests are padded onto declared
+  length buckets (``ServeConfig.length_buckets``, per-request ragged
+  feeds) and formed batches onto batch buckets
+  (``ServeConfig.batch_buckets``, default powers of two up to
+  ``max_batch``) — the ``compile/`` bucketing discipline applied to
+  serving. Every (batch, length) signature is **AOT-compiled at
+  construction** (``warmup=True``), so live traffic never meets an XLA
+  compile; any dispatch that misses the executable table counts into
+  ``serve.recompiles`` (a healthy session holds it at 0).
+* **the dynamic micro-batcher** (serve/batcher.py) for one-shot
+  inference, or **the slot-based continuous scheduler**
+  (serve/continuous.py) when a :class:`DecodeProgram` is passed.
+* **observability** — ``serve.*`` metrics (queue depth, batch
+  occupancy, request latency, time-to-first-token, tokens/sec,
+  shed/timeout counters) in the shared registry and a
+  ``serve.request`` span per request on the obs/ timeline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from parallax_tpu.common.config import ParallaxConfig
+from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.compile import bucketing
+from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
+from parallax_tpu.obs import metrics as obs_metrics, trace
+from parallax_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
+                                        Request, RequestQueue,
+                                        ServeClosed, ServeError)
+
+
+class ServeSession:
+    """Serve ``infer_fn(params, batch) -> outputs`` (one-shot mode) or
+    a :class:`~parallax_tpu.serve.continuous.DecodeProgram` (continuous
+    decode mode) behind a dynamic micro-batching request queue.
+
+    One-shot mode::
+
+        serve = ServeSession(infer_fn, params, example_feed={"x": x0},
+                             config=parallax.Config(
+                                 serve_config=ServeConfig(max_batch=8)))
+        req = serve.submit({"x": x}, deadline_ms=50)
+        y = req.result()
+        serve.close()
+
+    ``example_feed`` is ONE request's feed (no batch dim); outputs must
+    carry the batch on dim 0 of every leaf (scalars pass through to
+    every request unchanged). Decode mode replaces ``infer_fn`` with
+    ``program=`` and ``submit`` returns the decoded token array.
+    """
+
+    def __init__(self, infer_fn: Optional[Callable] = None,
+                 params: Any = None, *,
+                 example_feed: Optional[Dict[str, Any]] = None,
+                 config: Optional[ParallaxConfig] = None,
+                 model: Optional[engine_lib.Model] = None,
+                 mesh=None, num_partitions: Optional[int] = None,
+                 ragged_feeds: Sequence[str] = (),
+                 pad_value=0, warmup: bool = True,
+                 program=None,
+                 metrics: Optional[obs_metrics.MetricsRegistry] = None):
+        if jax.process_count() > 1:
+            raise ValueError(
+                "ServeSession is single-process (each serving replica "
+                "owns its own queue); run one session per host")
+        if (infer_fn is None) == (program is None):
+            raise ValueError(
+                "pass exactly one of infer_fn (one-shot) or program "
+                "(continuous decode)")
+        self._config = config or ParallaxConfig()
+        sc = self._config.serve_config
+        self.mesh = mesh if mesh is not None else mesh_lib.build_mesh(
+            num_partitions=num_partitions)
+        self.metrics = metrics if metrics is not None \
+            else obs_metrics.MetricsRegistry()
+        self._recompiles = self.metrics.counter("serve.recompiles")
+        self._requests = self.metrics.counter("serve.requests")
+        self._completed = self.metrics.counter("serve.completed")
+        self._batches = self.metrics.counter("serve.batches")
+        self._latency = self.metrics.histogram("serve.request_latency_ms")
+        self._occupancy = self.metrics.histogram("serve.batch_occupancy")
+        self._step_ms = self.metrics.histogram("serve.step_ms")
+        self._batcher_ms = self.metrics.histogram(
+            "serve.batcher_overhead_ms")
+        self._h2d_ms = self.metrics.histogram("serve.h2d_ms")
+        self._queue = RequestQueue(sc.max_queue, self.metrics)
+        self._closed = False
+        self._close_lock = threading.Lock()
+
+        if program is not None:
+            # continuous decode: the scheduler owns dispatch
+            from parallax_tpu.serve.continuous import ContinuousScheduler
+            self._params = self._place_params(params, model, program)
+            self._scheduler = ContinuousScheduler(
+                program, self._params, sc, self.metrics, self._queue)
+            self._batcher = None
+            return
+        self._scheduler = None
+
+        if params is None or example_feed is None:
+            raise ValueError(
+                "one-shot serving needs params and example_feed (one "
+                "request's feed dict, no batch dim)")
+        self._infer_fn = infer_fn
+        self._example = {k: np.asarray(v) for k, v in example_feed.items()}
+        self._ragged = tuple(ragged_feeds)
+        self._pad_value = pad_value
+        unknown = set(self._ragged) - set(self._example)
+        if unknown:
+            raise ValueError(
+                f"ragged_feeds {sorted(unknown)} not in example_feed "
+                f"{sorted(self._example)}")
+        if self._ragged and not sc.length_buckets:
+            raise ValueError(
+                "ragged_feeds declared but ServeConfig.length_buckets "
+                "is unset; declare the length signature set so live "
+                "traffic cannot recompile")
+        for name in self._ragged:
+            if self._example[name].ndim < 1:
+                raise ValueError(
+                    f"ragged feed {name!r} must have a length axis "
+                    f"(ndim >= 1)")
+        self._batch_buckets = sc.resolved_batch_buckets()
+        self._params = self._place_params(params, model, None)
+        self._infer_jit = jax.jit(self._infer_fn)
+        # the admitted per-request signatures: a submit whose padded
+        # feed is not one of these is REFUSED at admission (it could
+        # only be served by a serve-time compile)
+        lengths = (sc.length_buckets if self._ragged else None) or (None,)
+        self._admitted = {
+            bucketing.batch_signature(self._padded_example(L))
+            for L in lengths}
+        # signature -> AOT executable; populated by warmup(), consulted
+        # on every dispatch (a miss = a serve-time compile = counted)
+        self._executables: Dict[tuple, Any] = {}
+        self.warmup_seconds: Dict[tuple, float] = {}
+        if warmup:
+            self.warmup()
+        self._batcher = MicroBatcher(self._queue, self._run_batch,
+                                     sc.max_batch, sc.max_wait_ms)
+
+    # -- planning ----------------------------------------------------------
+
+    def _place_params(self, params, model, program):
+        """Place the parameter pytree on the serve mesh: by the
+        engine's sharding plan when a Model is given (the training
+        layout — row-sharded tables stay sharded), else replicated
+        (the standard serving layout)."""
+        if params is None:
+            raise ValueError("ServeSession needs a params pytree")
+        leaves = jax.tree_util.tree_leaves(params)
+        if model is None and leaves and all(
+                isinstance(x, jax.Array)
+                and getattr(getattr(x, "sharding", None), "mesh", None)
+                == self.mesh for x in leaves):
+            # the session.serve() handoff: the live TrainState's params
+            # already sit on this mesh under the training plan — keep
+            # that placement (no copy, row-sharded tables stay sharded)
+            return params
+        if model is not None:
+            params_shapes = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    np.shape(x), engine_lib._dtype_of(x)), params)
+            example = self._plan_example_batch(program)
+            batch_shapes = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                               np.asarray(x).dtype),
+                example)
+            plan = engine_lib.build_plan(model, self.mesh, self._config,
+                                         params_shapes, batch_shapes)
+            shardings = jax.tree.map(
+                lambda spec: NamedSharding(self.mesh, spec),
+                plan.param_pspecs,
+                is_leaf=lambda x: isinstance(x, P))
+            return jax.device_put(params, shardings)
+        repl = NamedSharding(self.mesh, P())
+        return jax.tree.map(lambda x: jax.device_put(x, repl), params)
+
+    def _plan_example_batch(self, program):
+        """A full-batch example feed for plan classification."""
+        b = int(self._config.serve_config.max_batch)
+        if program is not None:
+            ex = program.example_feed()
+        else:
+            ex = self._padded_example(self._max_length_bucket())
+        return {k: np.stack([v] * b) for k, v in ex.items()}
+
+    # -- the bounded signature set ----------------------------------------
+
+    def _max_length_bucket(self) -> Optional[int]:
+        lb = self._config.serve_config.length_buckets
+        return lb[-1] if lb else None
+
+    def _padded_example(self, L: Optional[int]) -> Dict[str, np.ndarray]:
+        """The example feed with every ragged feed padded to length
+        ``L`` (identity when no length buckets are declared)."""
+        if L is None or not self._ragged:
+            return self._example
+        out = dict(self._example)
+        for name in self._ragged:
+            out[name] = bucketing.pad_axis0(
+                out[name][:L], L, self._pad_value)
+        return out
+
+    def _batch_sharding_fn(self, bucket: int):
+        """Placement rule for a batch of size ``bucket``: sharded on
+        dim 0 over the mesh when the bucket divides the devices (data-
+        parallel serving), replicated otherwise (small micro-batches on
+        big meshes). Decided per BUCKET, so placement is part of the
+        signature and stable across dispatches."""
+        n = mesh_lib.num_devices(self.mesh)
+        if bucket % n == 0:
+            return lambda ndim: NamedSharding(self.mesh,
+                                              mesh_lib.batch_spec(ndim))
+        return lambda ndim: NamedSharding(self.mesh, P())
+
+    def _signature_set(self):
+        """Every (batch bucket, length bucket) aval dict the session
+        serves — the COMPLETE set warmup compiles."""
+        lengths = (self._config.serve_config.length_buckets
+                   if self._ragged else None) or (None,)
+        for L in lengths:
+            ex = self._padded_example(L)
+            for b in self._batch_buckets:
+                shard_fn = self._batch_sharding_fn(b)
+                avals = {
+                    name: jax.ShapeDtypeStruct(
+                        (b,) + tuple(v.shape), v.dtype,
+                        sharding=shard_fn(v.ndim + 1))
+                    for name, v in ex.items()}
+                yield (b, L), avals
+
+    def warmup(self) -> Dict[tuple, float]:
+        """AOT-compile every declared (batch, length) signature;
+        idempotent. Returns {(batch, length): compile seconds}."""
+        stats: Dict[tuple, float] = {}
+        for key, avals in self._signature_set():
+            sig = bucketing.batch_signature(avals)
+            if sig in self._executables:
+                continue
+            t0 = time.perf_counter()
+            with trace.span("serve.warmup_compile", batch=key[0],
+                            length=key[1]):
+                self._executables[sig] = self._infer_jit.lower(
+                    self._params, avals).compile()
+            dt = time.perf_counter() - t0
+            self.metrics.histogram("serve.compile_seconds").record(dt)
+            stats[key] = dt
+            parallax_log.info(
+                "serve warmup: compiled signature batch=%s length=%s "
+                "in %.2fs", key[0], key[1], dt)
+        self.warmup_seconds.update(stats)
+        return stats
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, feed: Dict[str, Any],
+               deadline_ms: Optional[float] = None,
+               max_new_tokens: Optional[int] = None) -> Request:
+        """Admit one request; returns its :class:`Request` future.
+
+        Raises :class:`ServeOverloaded` when admission control sheds it
+        (queue full) and :class:`ServeClosed` after ``close()``. The
+        deadline (``deadline_ms`` or ``ServeConfig.default_deadline_ms``)
+        bounds QUEUE+SERVE time: an expired request is dropped with
+        :class:`DeadlineExceeded` instead of served late.
+        """
+        sc = self._config.serve_config
+        ddl_ms = (deadline_ms if deadline_ms is not None
+                  else sc.default_deadline_ms)
+        deadline = (time.perf_counter() + float(ddl_ms) / 1e3
+                    if ddl_ms is not None else None)
+        if self._scheduler is not None:
+            req = self._scheduler.make_request(feed, deadline,
+                                               max_new_tokens)
+        else:
+            req = self._make_one_shot_request(feed, deadline)
+        self._requests.inc()
+        self._queue.put(req)  # raises ServeOverloaded / ServeClosed
+        if self._scheduler is not None:
+            self._scheduler.kick()
+        return req
+
+    def _make_one_shot_request(self, feed, deadline) -> Request:
+        feed = {k: np.asarray(v) for k, v in feed.items()}
+        if set(feed) != set(self._example):
+            raise ValueError(
+                f"feed names {sorted(feed)} != example names "
+                f"{sorted(self._example)}")
+        if self._ragged:
+            lb = self._config.serve_config.length_buckets
+            longest = max(feed[n].shape[0] for n in self._ragged)
+            L = bucketing.length_bucket(longest, lb)
+            if L is None:
+                raise ValueError(
+                    f"request length {longest} exceeds the largest "
+                    f"declared length bucket {lb[-1]}")
+            for name in self._ragged:
+                feed[name] = bucketing.pad_axis0(feed[name], L,
+                                                 self._pad_value)
+        # requests in one device batch must share a signature
+        group_key = bucketing.batch_signature(feed)
+        if group_key not in self._admitted:
+            raise ValueError(
+                f"request signature {[(n, s) for n, s, _ in group_key]} "
+                f"is outside the declared serving set "
+                f"{sorted([(n, s) for n, s, _ in sig] for sig in self._admitted)}; "
+                f"serving it would compile at serve time — fix the "
+                f"feed shapes or declare matching length_buckets")
+        return Request(feed, deadline=deadline, group_key=group_key)
+
+    # -- dispatch (batcher thread) ----------------------------------------
+
+    def _run_batch(self, requests) -> None:
+        t_host0 = time.perf_counter()
+        # deadline re-check at dispatch: form_group sheds while
+        # requests WAIT, but one can expire between dequeue and here —
+        # don't spend device time on a caller who already gave up
+        live = []
+        for r in requests:
+            if r.deadline is not None and t_host0 > r.deadline:
+                self.metrics.counter("serve.timeouts").inc()
+                r._fail(DeadlineExceeded(
+                    f"request {r.id} deadline expired at dispatch"))
+            else:
+                live.append(r)
+        requests = live
+        if not requests:
+            return
+        n = len(requests)
+        bucket = next(b for b in self._batch_buckets if b >= n)
+        batch = {}
+        for name in requests[0].feed:
+            rows = [r.feed[name] for r in requests]
+            if n < bucket:
+                # edge-pad with the last real request's row (finite for
+                # finite data; padded rows are discarded at split time)
+                rows = rows + [rows[-1]] * (bucket - n)
+            batch[name] = np.stack(rows)
+        sig = bucketing.batch_signature(batch)
+        exe = self._executables.get(sig)
+        t_form = time.perf_counter()
+        with trace.span("serve.h2d_place", bucket=bucket):
+            placed = engine_lib.place_host_batch(
+                self.mesh, batch,
+                default_sharding_fn=self._batch_sharding_fn(bucket))
+        t_host1 = time.perf_counter()
+        # H2D is the feed path (any inference pays it, batched or
+        # not) — recorded on its own, NOT as batcher overhead
+        self._h2d_ms.record((t_host1 - t_form) * 1e3)
+        with trace.span("serve.infer", n=n, bucket=bucket):
+            if exe is not None:
+                out = exe(self._params, placed)
+            else:
+                # a serve-time compile: the signature set was supposed
+                # to be closed — count it loudly, serve the request
+                # anyway through the jit path
+                self._recompiles.inc()
+                parallax_log.warning(
+                    "serve dispatch missed the AOT executable table "
+                    "(signature %s); compiling at serve time — declare "
+                    "batch/length buckets covering this shape",
+                    [(k, s) for k, s, _ in sig])
+                out = self._infer_jit(self._params, placed)
+            host = jax.tree.map(np.asarray, out)  # block: result ready
+        t_step = time.perf_counter() - t_host1
+        t_host2 = time.perf_counter()
+        now = t_host2
+        # split once at the leaf level (one flatten for the whole
+        # batch, not one tree traversal per request)
+        leaves, treedef = jax.tree_util.tree_flatten(host)
+        batched = [np.ndim(a) >= 1 for a in leaves]
+        delivered = 0
+        for i, r in enumerate(requests):
+            if r.deadline is not None and now > r.deadline:
+                # the step itself overran the budget: the deadline
+                # contract is "meet it or shed it", so a late result
+                # is DROPPED, never delivered (counted as a timeout)
+                self.metrics.counter("serve.timeouts").inc()
+                r._fail(DeadlineExceeded(
+                    f"request {r.id} missed its deadline by "
+                    f"{(now - r.deadline) * 1e3:.1f}ms during service"))
+                continue
+            r._complete(jax.tree_util.tree_unflatten(
+                treedef, [a[i] if s else a
+                          for a, s in zip(leaves, batched)]))
+            delivered += 1
+            self._latency.record((now - r.t_enqueue) * 1e3)
+            trace.record_span("serve.request", r.t_enqueue, now,
+                              id=r.id, batch=bucket)
+        self._completed.inc(delivered)
+        self._batches.inc()
+        self._occupancy.record(n / bucket)
+        self._step_ms.record(t_step * 1e3)
+        # the batching layer's own host cost on the dispatch path:
+        # batch formation (stack/pad, signature, executable lookup) +
+        # result split + bookkeeping — everything this call does
+        # beyond the feed path (h2d above) and the device step; the
+        # number tools/check_serve_slo.py holds to <=5% of step
+        # wall-time
+        self._batcher_ms.record(
+            ((t_form - t_host0)
+             + (time.perf_counter() - t_host2)) * 1e3)
+
+    # -- introspection / teardown -----------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """JSON-ready snapshot of every ``serve.*`` metric."""
+        return {k: v for k, v in self.metrics.snapshot().items()
+                if k.startswith("serve.")}
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admission; with ``drain`` (default) serve the accepted
+        queue to completion (bounded by
+        ``ServeConfig.drain_timeout_s``), then fail whatever remains
+        with :class:`ServeClosed`. Idempotent."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        sc = self._config.serve_config
+        self._queue.close()
+        timeout = sc.drain_timeout_s if drain else 0.0
+        if self._scheduler is not None:
+            self._scheduler.drain(timeout)
+        elif self._batcher is not None:
+            self._batcher.drain(timeout)
+        n = self._queue.fail_all(ServeClosed("session closed"))
+        if n:
+            parallax_log.warning(
+                "serve close: failed %d undrained request(s)", n)
+
+    def __enter__(self) -> "ServeSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+__all__ = ["ServeSession", "ServeError"]

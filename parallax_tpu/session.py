@@ -700,6 +700,31 @@ class ParallaxSession:
             },
         }
 
+    # -- online serving (serve/) ------------------------------------------
+
+    def serve(self, infer_fn=None, program=None, **kw):
+        """Put the live trained parameters behind a request queue: a
+        :class:`~parallax_tpu.serve.session.ServeSession` sharing this
+        session's mesh (no second mesh build), its parameter pytree
+        (``state.params`` as-is — no host round trip) and its metrics
+        registry (``serve.*`` lands next to ``pipeline.*``). Pass
+        ``infer_fn(params, batch)`` for one-shot inference (plus
+        ``example_feed=``) or ``program=`` for continuous decode;
+        remaining kwargs forward to ``ServeSession``. Requires a built
+        engine (``prepare(example_feed)`` or any step first). Serving
+        knobs come from this session's
+        ``Config.serve_config``. Close the serve session before this
+        one."""
+        from parallax_tpu.serve import ServeSession
+        if self._engine is None:
+            raise ValueError(
+                "serve() needs a built engine: call "
+                "prepare(example_feed) (or run a step) first")
+        return ServeSession(infer_fn, self._state.params,
+                            program=program, config=self._config,
+                            mesh=self._engine.mesh, metrics=self.metrics,
+                            **kw)
+
     # -- partition search (reference: common/partitions.py) ---------------
 
     def _record_search_time(self, dt: float) -> None:

@@ -1,0 +1,124 @@
+"""Full-model backward HLO gates (VERDICT r5 next item 7).
+
+tests/test_tensor_parallel.py pins the Megatron collective pattern and
+the no-remat guarantee for one ISOLATED block; these gates extend them
+to the programs that actually train — the engine's full compiled step
+(forward + backward + optimizer, sparse embedding path included) for
+BERT and NMT with tensor parallelism on — so a sharding-spec
+regression anywhere in the stack (a lost activation pin, a
+replicate-and-repartition fallback, an embedding misroute) shows up as
+a collective-count or involuntary-remat delta here even when the
+isolated block still compiles cleanly.
+
+Mesh is (repl=1, shard=4): with a single repl row the data-parallel
+weight-grad psums vanish, so every collective in the text belongs to
+the TP pattern or the sparse embedding exchange and the counts are
+attributable.
+
+Count philosophy (same split as the block test): the INVARIANTS
+asserted on every toolchain are structural — zero involuntary
+rematerializations, the Megatron f/g all-reduces present and scaling
+with depth, no unexpected collective kinds. The EXACT per-op counts
+are additionally pinned on the host-XLA toolchain tier-1 runs on
+(which collective a reshard lowers to is an XLA partitioner choice,
+so exact numbers are per-toolchain facts — the pins freeze this
+build's healthy lowering; a changed count means the partitioning of
+the step changed and must be re-derived, not papered over).
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+import parallax_tpu as parallax
+from parallax_tpu.core import engine as engine_lib
+from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD
+from parallax_tpu.models import bert, nmt
+
+COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+               "all-to-all", "collective-permute")
+
+
+def _counts(text: str) -> dict:
+    return {k: text.count(f" {k}(") for k in COLLECTIVES}
+
+
+def _tp_mesh() -> Mesh:
+    devs = np.array(jax.devices()[:4]).reshape(1, 4)
+    return Mesh(devs, (AXIS_REPL, AXIS_SHARD))
+
+
+def _compile_full_step(model, example_batch, capfd):
+    """Build the real engine on the (1,4) mesh and compile its full
+    train step; returns (optimized HLO text, captured stderr)."""
+    eng = engine_lib.Engine(
+        model, _tp_mesh(),
+        parallax.Config(run_option="HYBRID", search_partitions=False),
+        example_batch)
+    state = eng.init_state(0)
+    placed = eng.shard_batch(example_batch)
+    capfd.readouterr()                                   # drain
+    compiled = eng._step_jit.lower(state, placed).compile()
+    err = capfd.readouterr().err
+    return compiled.as_text(), err
+
+
+# Exact pins for THIS host-XLA toolchain (see module docstring): the
+# recorded healthy lowering of each full step at 2 layers, heads=4,
+# shard=4, batch 8. Re-derive (don't relax) on any change.
+BERT_EXPECTED = {"all-reduce": 42, "all-gather": 23,
+                 "reduce-scatter": 1, "all-to-all": 0,
+                 "collective-permute": 17}
+NMT_EXPECTED = {"all-reduce": 102, "all-gather": 41,
+                "reduce-scatter": 2, "all-to-all": 7,
+                "collective-permute": 2}
+
+
+def _assert_gates(counts: dict, err: str, expected: dict,
+                  num_layers: int, min_ar_per_layer: int):
+    # 1) the r4 regression class, on the FULL model: GSPMD must never
+    #    fall back to full rematerialization anywhere in the step
+    assert "Involuntary full rematerialization" not in err, err[-2000:]
+    # 2) the Megatron f/g operators exist and scale with depth:
+    #    >= (fwd + bwd) ARs per transformer layer, on any toolchain
+    assert counts["all-reduce"] >= min_ar_per_layer * num_layers, counts
+    # 3) exact per-toolchain pin (host XLA = the tier-1 rig). On other
+    #    backends (TPU) the partitioner picks different primitives per
+    #    reshard; the structural gates above still hold there.
+    if jax.default_backend() == "cpu":
+        assert counts == expected, (counts, expected)
+
+
+def test_bert_full_model_backward_collective_pattern(capfd):
+    cfg = bert.tiny_config(tensor_parallel=True, num_partitions=4,
+                           num_heads=4)
+    model = bert.build_model(cfg)
+    batch = bert.make_batch(np.random.default_rng(0), 8, 16, 4,
+                            cfg.vocab_size)
+    text, err = _compile_full_step(model, batch, capfd)
+    counts = _counts(text)
+    # per layer: fwd attention-out + mlp-down ARs (the g operators)
+    # and their backward f counterparts => >= 4 AR/layer; the
+    # remainder (embedding exchange, logits psum) rides on top
+    _assert_gates(counts, err, BERT_EXPECTED, cfg.num_layers,
+                  min_ar_per_layer=4)
+
+
+def test_nmt_full_model_backward_collective_pattern(capfd):
+    cfg = nmt.tiny_config(tensor_parallel=True, num_partitions=4,
+                          num_heads=4)
+    model = nmt.build_model(cfg)
+    batch = nmt.make_batch(np.random.default_rng(0), 8, 12, 12,
+                           cfg.vocab_size)
+    text, err = _compile_full_step(model, batch, capfd)
+    counts = _counts(text)
+    # per encoder+decoder layer pair: enc (self-attn + mlp) = 2 fwd
+    # ARs, dec (self + cross + mlp) = 3 fwd ARs, doubled by the
+    # backward f operators => >= 10 AR per num_layers step
+    _assert_gates(counts, err, NMT_EXPECTED, cfg.num_layers,
+                  min_ar_per_layer=10)
+    # the decoder's head-split reshards lower to all-to-all on this
+    # build even on host XLA — their disappearance would mean the
+    # reshard vanished (a parallax sharding-spec regression)
+    if jax.default_backend() == "cpu":
+        assert counts["all-to-all"] > 0, counts

@@ -1,0 +1,475 @@
+"""Online serving subsystem (ISSUE 4): dynamic micro-batching,
+continuous decode, SLO guardrails.
+
+Covers the batcher unit level (admission control, deadline shedding,
+group formation, drain), the ServeSession one-shot contract (results
+identical to direct inference, mixed-length traffic over the
+pre-registered signature set never recompiles), the continuous-decode
+acceptance test (different target lengths finish with slot refill,
+token-identical to per-request standalone decode), the train->serve
+handoff (``ParallaxSession.serve``), and the tier-1 SLO guard
+(tools/check_serve_slo.py via a subprocess driver, the
+check_compile_budget pattern — isolation turns the known XLA:CPU
+multi-mesh abort into a retry instead of a suite kill).
+"""
+
+import os
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import parallax_tpu as parallax
+from parallax_tpu import ServeConfig
+from parallax_tpu.serve import (DeadlineExceeded, NMTDecodeProgram,
+                                Request, RequestQueue, ServeClosed,
+                                ServeOverloaded, ServeSession)
+from test_compile import _CompileCounter, _run_driver_json
+
+
+# -- request queue / admission control -------------------------------------
+
+
+class TestRequestQueue:
+    def test_fifo_and_depth_bound(self):
+        q = RequestQueue(max_queue=2)
+        a, b = Request({"x": 1}), Request({"x": 2})
+        q.put(a)
+        q.put(b)
+        with pytest.raises(ServeOverloaded):
+            q.put(Request({"x": 3}))
+        assert q.pop() is a and q.pop() is b
+
+    def test_expired_requests_are_shed_with_deadline_exceeded(self):
+        q = RequestQueue(max_queue=8)
+        dead = Request({"x": 1}, deadline=time.perf_counter() - 0.01)
+        live = Request({"x": 2})
+        q.put(dead)
+        q.put(live)
+        assert q.pop() is live
+        with pytest.raises(DeadlineExceeded):
+            dead.result(timeout=1.0)
+
+    def test_form_group_batches_by_key_in_fifo_order(self):
+        q = RequestQueue(max_queue=16)
+        reqs = [Request({"x": i}, group_key=("a" if i % 2 else "b"))
+                for i in range(6)]
+        for r in reqs:
+            q.put(r)
+        stop = threading.Event()
+        # oldest request (i=0, key "b") picks the group
+        g1 = q.form_group(4, max_wait_s=0.0, stop=stop)
+        assert [r.feed["x"] for r in g1] == [0, 2, 4]
+        g2 = q.form_group(4, max_wait_s=0.0, stop=stop)
+        assert [r.feed["x"] for r in g2] == [1, 3, 5]
+
+    def test_form_group_waits_for_fill_or_age(self):
+        q = RequestQueue(max_queue=16)
+        stop = threading.Event()
+        q.put(Request({"x": 0}))
+        t0 = time.perf_counter()
+        got = q.form_group(4, max_wait_s=0.05, stop=stop)
+        waited = time.perf_counter() - t0
+        assert len(got) == 1 and waited >= 0.04
+        # a full group dispatches without aging
+        for i in range(4):
+            q.put(Request({"x": i}))
+        t0 = time.perf_counter()
+        got = q.form_group(4, max_wait_s=10.0, stop=stop)
+        assert len(got) == 4
+        assert time.perf_counter() - t0 < 5.0
+
+    def test_closed_queue_rejects_and_drains(self):
+        q = RequestQueue(max_queue=8)
+        r = Request({"x": 1})
+        q.put(r)
+        q.close()
+        with pytest.raises(ServeClosed):
+            q.put(Request({"x": 2}))
+        # draining still serves the accepted request, immediately
+        got = q.form_group(4, max_wait_s=10.0, stop=threading.Event())
+        assert got == [r]
+        n = q.fail_all(ServeClosed("gone"))
+        assert n == 0
+
+
+# -- one-shot serving ------------------------------------------------------
+
+
+def _mlp_serve(max_batch=4, length_buckets=(8, 16), dim=8,
+               max_wait_ms=2.0, **sc_kw):
+    rng = jax.random.PRNGKey(0)
+    params = {"w": jax.random.normal(rng, (dim, dim)) / np.sqrt(dim)}
+
+    def infer_fn(params, batch):
+        h = jnp.tanh(batch["x"] @ params["w"])
+        return {"score": h.mean(axis=(1, 2)),
+                "norm": jnp.linalg.norm(
+                    h.reshape(h.shape[0], -1), axis=-1)}
+
+    cfg = parallax.Config(serve_config=ServeConfig(
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        length_buckets=list(length_buckets), **sc_kw))
+    sess = ServeSession(
+        infer_fn, params,
+        example_feed={"x": np.zeros((length_buckets[-1], dim),
+                                    np.float32)},
+        config=cfg, ragged_feeds=("x",))
+    return sess, params, infer_fn
+
+
+class TestOneShotServing:
+    def test_results_match_direct_inference(self, rng):
+        sess, params, infer_fn = _mlp_serve()
+        try:
+            feeds = [{"x": rng.standard_normal((L, 8))
+                      .astype(np.float32)} for L in (5, 8, 13, 3, 16, 7)]
+            reqs = [sess.submit(f) for f in feeds]
+            for f, r in zip(feeds, reqs):
+                got = r.result(timeout=30.0)
+                # reference: the same padded example through the raw fn
+                from parallax_tpu.compile import bucketing
+                L = bucketing.length_bucket(
+                    f["x"].shape[0], sess._config.serve_config
+                    .length_buckets)
+                x = bucketing.pad_axis0(f["x"], L)[None]
+                want = jax.tree.map(np.asarray,
+                                    infer_fn(params, {"x": x}))
+                np.testing.assert_allclose(got["score"],
+                                           want["score"][0], rtol=1e-5)
+                np.testing.assert_allclose(got["norm"],
+                                           want["norm"][0], rtol=1e-5)
+        finally:
+            sess.close()
+
+    def test_mixed_length_traffic_never_recompiles(self, rng):
+        """The acceptance invariant: the declared (batch x length)
+        signature set is closed — mixed ragged traffic dispatches AOT
+        executables only."""
+        sess, *_ = _mlp_serve()
+        try:
+            with _CompileCounter() as cc:
+                reqs = [sess.submit(
+                    {"x": rng.standard_normal(
+                        (int(rng.integers(1, 17)), 8))
+                     .astype(np.float32)}) for _ in range(24)]
+                for r in reqs:
+                    r.result(timeout=30.0)
+            assert cc.count == 0, (
+                f"{cc.count} XLA compile(s) during serving")
+            assert sess.stats()["serve.recompiles"] == 0
+            # the jit path was never taken either
+            assert sess._infer_jit._cache_size() == 0
+        finally:
+            sess.close()
+
+    def test_oversize_length_refused_at_submit(self, rng):
+        sess, *_ = _mlp_serve()
+        try:
+            with pytest.raises(ValueError, match="length bucket"):
+                sess.submit({"x": np.zeros((17, 8), np.float32)})
+        finally:
+            sess.close()
+
+    def test_off_signature_request_refused_not_compiled(self):
+        """A feed outside the declared serving set is REFUSED at
+        admission — it could only be served by a serve-time compile,
+        which the signature-set contract forbids. Covers wrong
+        non-ragged dims and wrong dtypes alike."""
+        sess, *_ = _mlp_serve()
+        try:
+            with pytest.raises(ValueError, match="declared serving"):
+                sess.submit({"x": np.zeros((8, 9), np.float32)})
+            with pytest.raises(ValueError, match="declared serving"):
+                sess.submit({"x": np.zeros((8, 8), np.float64)})
+            assert sess.stats()["serve.recompiles"] == 0
+        finally:
+            sess.close()
+
+    def test_deadline_sheds_instead_of_serving_late(self):
+        """A request whose deadline expires in the queue fails with
+        DeadlineExceeded — never served late, counted as a timeout."""
+        sess, *_ = _mlp_serve(max_wait_ms=200.0)
+        try:
+            # an expired request: deadline in the past at submit time
+            r = sess.submit({"x": np.zeros((8, 8), np.float32)},
+                            deadline_ms=0.001)
+            with pytest.raises(DeadlineExceeded):
+                r.result(timeout=10.0)
+            assert sess.stats()["serve.timeouts"] >= 1
+        finally:
+            sess.close()
+
+    def test_overload_sheds_at_admission(self):
+        sess, *_ = _mlp_serve(max_batch=2, max_wait_ms=100.0,
+                              max_queue=2)
+        try:
+            shed, accepted = 0, []
+            for _ in range(16):
+                try:
+                    accepted.append(sess.submit(
+                        {"x": np.zeros((8, 8), np.float32)}))
+                except ServeOverloaded:
+                    shed += 1
+            assert shed > 0
+            for r in accepted:
+                r.result(timeout=30.0)
+            assert sess.stats()["serve.shed"] == shed
+        finally:
+            sess.close()
+
+    def test_close_drains_then_rejects(self):
+        sess, *_ = _mlp_serve(max_wait_ms=50.0)
+        try:
+            reqs = [sess.submit({"x": np.zeros((8, 8), np.float32)})
+                    for _ in range(6)]
+        finally:
+            sess.close()  # drain: accepted requests still complete
+        for r in reqs:
+            assert r.result(timeout=1.0) is not None
+        with pytest.raises(ServeClosed):
+            sess.submit({"x": np.zeros((8, 8), np.float32)})
+
+    def test_close_without_drain_fails_queued_requests(self):
+        """close(drain=False) is the fast path: queued requests FAIL
+        with ServeClosed — they are not quietly served during
+        shutdown (review finding)."""
+        sess, *_ = _mlp_serve(max_batch=2, max_wait_ms=5000.0,
+                              max_queue=32)
+        try:
+            reqs = [sess.submit({"x": np.zeros((8, 8), np.float32)})
+                    for _ in range(8)]
+        finally:
+            sess.close(drain=False)
+        outcomes = []
+        for r in reqs:
+            try:
+                r.result(timeout=5.0)
+                outcomes.append("served")
+            except ServeClosed:
+                outcomes.append("closed")
+        # the batch in flight when close landed may legitimately have
+        # been served; everything still waiting must have failed
+        assert outcomes.count("closed") >= 6, outcomes
+
+    def test_batch_occupancy_and_latency_metrics_flow(self, rng):
+        sess, *_ = _mlp_serve()
+        try:
+            reqs = [sess.submit({"x": rng.standard_normal((8, 8))
+                                 .astype(np.float32)})
+                    for _ in range(8)]
+            for r in reqs:
+                r.result(timeout=30.0)
+            stats = sess.stats()
+            assert stats["serve.completed"] == 8
+            assert stats["serve.request_latency_ms"]["count"] == 8
+            assert stats["serve.batch_occupancy"]["count"] >= 1
+            assert 0 < stats["serve.batch_occupancy"]["max"] <= 1.0
+            assert stats["serve.step_ms"]["count"] >= 1
+        finally:
+            sess.close()
+
+
+# -- continuous decode (the acceptance test) -------------------------------
+
+
+def _nmt_rig(slots=3, T=12, Ts=8):
+    cfg = nmt_cfg()
+    params = _nmt_params(cfg)
+    prog = NMTDecodeProgram(cfg, max_src_len=Ts, max_len=T)
+    pcfg = parallax.Config(serve_config=ServeConfig(max_batch=slots,
+                                                    max_queue=64))
+    sess = ServeSession(program=prog, params=params, config=pcfg)
+    return sess, cfg, params
+
+
+def nmt_cfg():
+    from parallax_tpu.models import nmt
+    return nmt.tiny_config(vocab_size=64, model_dim=16, num_heads=2,
+                           mlp_dim=32, num_layers=2, max_len=16,
+                           num_partitions=1,
+                           compute_dtype=jnp.float32)
+
+
+def _nmt_params(cfg):
+    from parallax_tpu.models import nmt
+    return nmt.build_model(cfg).init_fn(jax.random.PRNGKey(0))
+
+
+class TestContinuousDecode:
+    def test_slot_refill_token_identical_to_standalone(self, rng):
+        """ISSUE 4 acceptance: a batch of requests with different
+        target lengths finishes with slot refill, producing
+        token-identical output to per-request standalone decode."""
+        from parallax_tpu.models import nmt
+        sess, cfg, params = _nmt_rig(slots=3)
+        try:
+            srcs = [rng.integers(3, 64, (L,)).astype(np.int32)
+                    for L in (6, 4, 8, 5, 7, 3)]
+            caps = [12, 5, 9, 12, 4, 8]      # different target lengths
+            reqs = [sess.submit({"src": s}, max_new_tokens=c)
+                    for s, c in zip(srcs, caps)]
+            outs = [r.result(timeout=120.0) for r in reqs]
+            stats = sess.stats()
+            # 6 requests over 3 slots: refill happened (more decode
+            # steps than any single request, fewer than sequential)
+            assert stats["serve.completed"] == 6
+            assert stats["serve.decode_steps"] < sum(caps)
+            assert stats["serve.batch_occupancy"]["max"] == 1.0
+            assert stats["serve.ttft_ms"]["count"] == 6
+        finally:
+            sess.close()
+        for src, cap, out in zip(srcs, caps, outs):
+            ref = np.asarray(nmt.greedy_decode(
+                params, cfg, src[None], max_len=cap))[0].tolist()
+            if nmt.EOS_ID in ref:
+                ref = ref[:ref.index(nmt.EOS_ID) + 1]
+            assert list(out) == ref, (src, list(out), ref)
+
+    def test_decode_deadline_expires_mid_flight(self, rng):
+        sess, cfg, params = _nmt_rig(slots=2)
+        try:
+            r = sess.submit({"src": rng.integers(3, 64, (6,))
+                             .astype(np.int32)},
+                            deadline_ms=0.001, max_new_tokens=12)
+            with pytest.raises(DeadlineExceeded):
+                r.result(timeout=30.0)
+            assert sess.stats()["serve.timeouts"] >= 1
+        finally:
+            sess.close()
+
+    def test_decode_drain_completes_accepted_requests(self, rng):
+        sess, cfg, params = _nmt_rig(slots=2)
+        reqs = [sess.submit({"src": rng.integers(3, 64, (5,))
+                             .astype(np.int32)}, max_new_tokens=6)
+                for _ in range(4)]
+        sess.close()  # drain serves all four
+        for r in reqs:
+            assert len(r.result(timeout=1.0)) >= 1
+
+    def test_tokens_per_sec_and_step_metrics(self, rng):
+        sess, cfg, params = _nmt_rig(slots=2)
+        try:
+            reqs = [sess.submit({"src": rng.integers(3, 64, (4,))
+                                 .astype(np.int32)}, max_new_tokens=8)
+                    for _ in range(3)]
+            for r in reqs:
+                r.result(timeout=60.0)
+            stats = sess.stats()
+            assert stats["serve.tokens"] >= 3
+            assert stats["serve.step_ms"]["count"] >= 1
+        finally:
+            sess.close()
+
+
+# -- train -> serve handoff ------------------------------------------------
+
+
+def test_parallax_session_serve_handoff(rng):
+    """ParallaxSession.serve(): the trained params go behind a queue
+    on the SAME mesh, serve.* metrics land in the session's registry,
+    and the served score equals direct inference on the live state."""
+    import optax
+
+    def init_fn(r):
+        return {"w": jax.random.normal(r, (8, 8)) * 0.1}
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def infer_fn(params, batch):
+        return (batch["x"] @ params["w"]).sum(-1).sum(-1)
+
+    sess, *_ = parallax.parallel_run(
+        parallax.Model(init_fn, loss_fn, optimizer=optax.sgd(0.05)),
+        parallax_config=parallax.Config(
+            run_option="AR", search_partitions=False, eager_fetch=True,
+            serve_config=ServeConfig(max_batch=2, max_wait_ms=2.0)))
+    try:
+        batch = {"x": rng.standard_normal((16, 8)).astype(np.float32),
+                 "y": rng.standard_normal((16, 8)).astype(np.float32)}
+        for _ in range(3):
+            sess.run("loss", feed_dict=batch)
+        serve = sess.serve(
+            infer_fn,
+            example_feed={"x": np.zeros((4, 8), np.float32)})
+        try:
+            assert serve.mesh is sess.engine.mesh
+            x = rng.standard_normal((4, 8)).astype(np.float32)
+            got = serve.submit({"x": x}).result(timeout=30.0)
+            want = float(np.asarray(infer_fn(
+                jax.tree.map(np.asarray, sess.state.params),
+                {"x": x[None]}))[0])
+            np.testing.assert_allclose(got, want, rtol=1e-5)
+            # shared registry: serve.* next to pipeline.*
+            snap = sess.metrics_snapshot()
+            assert snap["serve.completed"] == 1
+        finally:
+            serve.close()
+    finally:
+        sess.close()
+
+
+# -- config validation -----------------------------------------------------
+
+
+class TestServeConfig:
+    def test_defaults_and_bucket_resolution(self):
+        sc = ServeConfig(max_batch=8)
+        assert sc.resolved_batch_buckets() == (1, 2, 4, 8)
+        sc6 = ServeConfig(max_batch=6)
+        assert sc6.resolved_batch_buckets() == (1, 2, 4, 6)
+        assert ServeConfig(max_batch=4, batch_buckets=[4, 2]) \
+            .resolved_batch_buckets() == (2, 4)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="max_batch"):
+            ServeConfig(max_batch=0)
+        with pytest.raises(ValueError, match="max_wait_ms"):
+            ServeConfig(max_wait_ms=-1)
+        with pytest.raises(ValueError, match="max_queue"):
+            ServeConfig(max_queue=0)
+        with pytest.raises(ValueError, match="cover"):
+            ServeConfig(max_batch=8, batch_buckets=[1, 2])
+        with pytest.raises(ValueError, match="positive"):
+            ServeConfig(length_buckets=[0])
+        with pytest.raises(ValueError, match="default_deadline_ms"):
+            ServeConfig(default_deadline_ms=0)
+
+    def test_ragged_feeds_require_length_buckets(self):
+        with pytest.raises(ValueError, match="length_buckets"):
+            ServeSession(lambda p, b: b["x"], {"w": np.zeros(2)},
+                         example_feed={"x": np.zeros((4,), np.float32)},
+                         ragged_feeds=("x",), warmup=False)
+
+
+# -- the tier-1 SLO guard (subprocess driver) ------------------------------
+
+
+def test_serve_slo_guard():
+    """tools/check_serve_slo.py: mixed-length synthetic load over the
+    pre-registered buckets shows zero serve-time recompiles, every
+    accepted request meets or correctly sheds its deadline, and the
+    batcher's decomposed host cost stays <=5% of step wall-time. Run
+    as a subprocess (its own __main__ contract) for the same
+    toolchain-crash isolation as the compile-budget guard; the
+    overhead microbench gets one retry against pathological spikes."""
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "check_serve_slo.py")
+    last = None
+    for _attempt in range(2):
+        result = _run_driver_json(
+            [sys.executable, tool, "--requests", "64"],
+            check_rc=False, timeout=600.0)
+        hard = [v for v in result.get("violations", [])
+                if "overhead" not in v]
+        assert not hard, result
+        last = result
+        if result["ok"]:
+            break
+    assert last["ok"], last

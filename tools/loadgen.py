@@ -1,0 +1,179 @@
+"""Synthetic load generator for the serving subsystem.
+
+Drives a :class:`~parallax_tpu.serve.session.ServeSession` with
+closed-loop clients (each thread submits, waits for the result, then
+submits again — the standard saturating-load shape) over a caller-
+supplied feed generator, and reports per-request outcomes alongside
+the session's own ``serve.*`` metrics. Used by
+``tools/check_serve_slo.py`` (the tier-1 SLO contract), the BENCH
+"serve" section (bench.py), and runnable directly::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/loadgen.py
+
+which serves a small MLP scorer under a mixed-length load and prints
+one JSON report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_load(session, make_feed, n_requests: int, concurrency: int = 4,
+             deadline_ms=None, max_new_tokens=None,
+             result_timeout_s: float = 120.0) -> dict:
+    """Submit ``n_requests`` through ``concurrency`` closed-loop client
+    threads; ``make_feed(i)`` builds request ``i``'s feed. Returns the
+    outcome/latency report (shed and timed-out requests are counted,
+    not errors)."""
+    import numpy as np
+
+    from parallax_tpu.serve import (DeadlineExceeded, ServeClosed,
+                                    ServeOverloaded)
+
+    lock = threading.Lock()
+    counter = {"next": 0}
+    outcomes = {"completed": 0, "shed": 0, "timeout": 0, "failed": 0}
+    latencies = []
+    errors = []
+
+    def client():
+        while True:
+            with lock:
+                i = counter["next"]
+                if i >= n_requests:
+                    return
+                counter["next"] = i + 1
+            try:
+                req = session.submit(make_feed(i),
+                                     deadline_ms=deadline_ms,
+                                     max_new_tokens=max_new_tokens)
+            except ServeOverloaded:
+                with lock:
+                    outcomes["shed"] += 1
+                continue
+            try:
+                req.result(timeout=result_timeout_s)
+                with lock:
+                    outcomes["completed"] += 1
+                    latencies.append(req.latency_s())
+            except DeadlineExceeded:
+                with lock:
+                    outcomes["timeout"] += 1
+            except (ServeClosed, TimeoutError) as e:
+                with lock:
+                    outcomes["failed"] += 1
+                    errors.append(f"{type(e).__name__}: {e}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, name=f"loadgen-{k}",
+                                daemon=True)
+               for k in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    lat_ms = sorted(v * 1e3 for v in latencies)
+
+    def pct(q):
+        if not lat_ms:
+            return None
+        import math
+        return round(lat_ms[min(len(lat_ms) - 1,
+                                math.ceil(q * len(lat_ms)) - 1)], 3)
+
+    return {
+        "submitted": n_requests,
+        "completed": outcomes["completed"],
+        "shed": outcomes["shed"],
+        "timeouts": outcomes["timeout"],
+        "failed": outcomes["failed"],
+        "errors": errors[:5],
+        "wall_s": round(wall, 3),
+        "qps": round(outcomes["completed"] / wall, 2) if wall > 0 else None,
+        "latency_ms": {"p50": pct(0.50), "p95": pct(0.95),
+                       "max": round(lat_ms[-1], 3) if lat_ms else None},
+        "deadline_ms": deadline_ms,
+        "concurrency": concurrency,
+    }
+
+
+def demo_session(max_batch: int = 8, length_buckets=(16, 32),
+                 dim: int = 384, layers: int = 4, max_queue: int = 128,
+                 max_wait_ms: float = 2.0, default_deadline_ms=None):
+    """A small-MLP one-shot scorer behind a ServeSession — the shared
+    rig of the CLI, the SLO tool and the bench serve section. Returns
+    ``(session, make_feed)``."""
+    import jax
+    import numpy as np
+
+    import parallax_tpu as parallax
+
+    rng = jax.random.PRNGKey(0)
+    ws = []
+    for i in range(layers):
+        rng, k = jax.random.split(rng)
+        ws.append(jax.random.normal(k, (dim, dim)) / np.sqrt(dim))
+    params = {"w": ws}
+
+    def infer_fn(params, batch):
+        x = batch["x"]                       # [B, L, dim]
+        for w in params["w"]:
+            x = jax.nn.tanh(x @ w)
+        return {"score": x.mean(axis=(1, 2))}
+
+    cfg = parallax.Config(serve_config=parallax.ServeConfig(
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        max_queue=max_queue, length_buckets=list(length_buckets),
+        default_deadline_ms=default_deadline_ms))
+    sess = parallax.ServeSession(
+        infer_fn, params,
+        example_feed={"x": np.zeros((length_buckets[-1], dim),
+                                    np.float32)},
+        config=cfg, ragged_feeds=("x",))
+
+    lo, hi = max(1, length_buckets[0] // 2), length_buckets[-1]
+
+    def make_feed(i):
+        # per-request generator: make_feed is called concurrently from
+        # every client thread, and numpy Generators are not
+        # thread-safe — a shared one would corrupt the mixed-length
+        # coverage this rig exists to produce
+        r = np.random.default_rng(1000 + i)
+        L = int(r.integers(lo, hi + 1))
+        return {"x": r.standard_normal((L, dim)).astype(np.float32)}
+
+    return sess, make_feed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    args = ap.parse_args(argv)
+    sess, make_feed = demo_session()
+    try:
+        report = run_load(sess, make_feed, args.requests,
+                          concurrency=args.concurrency,
+                          deadline_ms=args.deadline_ms)
+        report["serve_metrics"] = sess.stats()
+    finally:
+        sess.close()
+    print(json.dumps(report, indent=2, default=str))
+    return 0 if report["failed"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

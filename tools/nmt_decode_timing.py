@@ -10,6 +10,10 @@ caches (O(T) total). Reference analogue:
 through tf.while_loop with the attention wrapper's state — the cached
 formulation. CPU timings (compile excluded) are structure, not
 hardware: the ratio's growth with T is the O(T) vs O(T^2) signature.
+
+``measure()`` is also stamped into the BENCH JSON as the ``decode``
+block (bench.py), so the serve-side latency primitive gets a per-round
+trajectory instead of this one-off perf file.
 """
 
 import json
@@ -20,7 +24,8 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def main(lengths=(32, 64, 128), batch=4, repeats=3):
+def measure(lengths=(32, 64, 128), batch=4, repeats=3) -> dict:
+    """Cached-vs-cacheless greedy decode wall times; JSON-ready."""
     import jax
     import numpy as np
 
@@ -48,10 +53,12 @@ def main(lengths=(32, 64, 128), batch=4, repeats=3):
         entry["cacheless_over_cached"] = round(
             entry["cacheless_ms"] / entry["cached_ms"], 2)
         rows.append(entry)
-        print(entry, flush=True)
+        # '#'-prefixed: bench.py calls measure() inline and its stdout
+        # contract is diagnostics behind '#' + ONE final JSON line
+        print(f"# {entry}", flush=True)
 
     ratios = [r["cacheless_over_cached"] for r in rows]
-    result = {
+    return {
         "what": "NMT greedy decode wall time, cached (O(T)) vs "
                 "cache-less (O(T^2)) — models/nmt.py",
         "platform": jax.devices()[0].platform,
@@ -61,6 +68,10 @@ def main(lengths=(32, 64, 128), batch=4, repeats=3):
         "ratio_grows_with_T": bool(all(
             b >= a for a, b in zip(ratios, ratios[1:]))),
     }
+
+
+def main(lengths=(32, 64, 128), batch=4, repeats=3):
+    result = measure(lengths=lengths, batch=batch, repeats=repeats)
     out_path = os.path.join(os.path.dirname(__file__), "..", "perf",
                             "NMT_DECODE_r05.json")
     with open(out_path, "w") as f:
