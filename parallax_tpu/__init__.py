@@ -14,7 +14,7 @@ additions `Model` (replaces the single-GPU tf.Graph as the unit handed to
 parallel_run) and the `ops` / `models` subpackages.
 """
 
-from parallax_tpu.common.config import (CheckPointConfig,
+from parallax_tpu.common.config import (AnomalyConfig, CheckPointConfig,
                                         CommunicationConfig, Config,
                                         MPIConfig, ParallaxConfig, PSConfig,
                                         ProfileConfig, ServeConfig)
@@ -32,7 +32,8 @@ __version__ = "0.1.0"
 __all__ = [
     "get_partitioner", "parallel_run", "shard", "log", "Config",
     "ParallaxConfig", "PSConfig", "MPIConfig", "CommunicationConfig",
-    "CheckPointConfig", "ProfileConfig", "ServeConfig", "Model",
+    "CheckPointConfig", "ProfileConfig", "ServeConfig", "AnomalyConfig",
+    "Model",
     "TrainState", "ParallaxSession", "Fetch", "StepHandle",
     "materialize", "compile", "obs", "ops", "serve", "ServeSession",
 ]

@@ -144,6 +144,71 @@ class ProfileConfig:
 
 
 @dataclasses.dataclass
+class AnomalyConfig:
+    """Knobs of the online anomaly detectors (``obs/anomaly.py``,
+    no reference analogue).
+
+    A *spike* is one observation far above the rolling baseline
+    (robust median/MAD test); a *shift* is a sustained level change —
+    the change-point case a single-outlier test misses (a step-time
+    regression, not a blip). Both count into ``anomaly.*`` and trigger
+    a flight-recorder dump when ``flight_dir`` is configured.
+
+    * ``enabled``: master switch (the obs kill switch also disables).
+    * ``window``: rolling baseline sample count per signal.
+    * ``min_samples``: observations before detection arms — compiles
+      and warmup steps land in the baseline, never fire it.
+    * ``spike_mads``: a spike must exceed the median by this many
+      (scaled) MADs…
+    * ``spike_min_ratio``: …AND by this multiplicative ratio (keeps a
+      near-constant signal, MAD ~ 0, from firing on microscopic
+      jitter).
+    * ``shift_window`` / ``shift_ratio``: a shift fires when the mean
+      of the last ``shift_window`` observations exceeds ``shift_ratio``
+      × the older window's median; the detector then rebaselines.
+    * ``cooldown``: observations before the same signal may fire again.
+    """
+
+    enabled: bool = True
+    window: int = 64
+    min_samples: int = 16
+    spike_mads: float = 8.0
+    spike_min_ratio: float = 2.0
+    shift_window: int = 8
+    shift_ratio: float = 1.5
+    cooldown: int = 32
+
+    def __post_init__(self):
+        if int(self.window) < 2:
+            raise ValueError(
+                f"anomaly window must be >= 2, got {self.window}")
+        if int(self.min_samples) < 2:
+            raise ValueError(
+                f"anomaly min_samples must be >= 2, got "
+                f"{self.min_samples}")
+        # arming requires min_samples observations IN the window, and
+        # the shift test needs shift_window more on top — a config
+        # violating either would be a silent no-op detector
+        if int(self.window) < int(self.min_samples):
+            raise ValueError(
+                f"anomaly window ({self.window}) must be >= "
+                f"min_samples ({self.min_samples}); detection would "
+                f"never arm")
+        if int(self.window) < int(self.min_samples) \
+                + max(2, int(self.shift_window)):
+            raise ValueError(
+                f"anomaly window ({self.window}) must be >= "
+                f"min_samples + shift_window "
+                f"({self.min_samples} + {self.shift_window}); the "
+                f"shift (change-point) detector would never arm")
+        for name in ("spike_mads", "spike_min_ratio", "shift_ratio"):
+            if float(getattr(self, name)) <= 0:
+                raise ValueError(
+                    f"anomaly {name} must be > 0, got "
+                    f"{getattr(self, name)}")
+
+
+@dataclasses.dataclass
 class ServeConfig:
     """Online-serving knobs (``parallax_tpu.serve``, no reference
     analogue — the reference is training-only).
@@ -346,6 +411,24 @@ class ParallaxConfig:
     # Re-format PARALLAX log lines as one JSON object per line (ts /
     # level / logger / msg) for machine-scraped runs.
     log_json: bool = False
+    # -- training forensics (obs/timeline, flightrec, anomaly) -----------
+    # Directory for flight-recorder auto-dumps: on a crash escaping a
+    # step, a non-finite loss (monitor_health=True), a serve SLO
+    # breach, or an anomaly firing, the session writes one JSON
+    # post-mortem artifact (last flight_steps timeline rows, health
+    # readings, anomaly events, metrics snapshot) there. None (default)
+    # disables auto-dumps — the bounded history still collects and
+    # session.dump_flight(path) works any time.
+    flight_dir: Optional[str] = None
+    # Ring capacity of the per-step timeline (and so of the flight
+    # recorder's step log): the last N steps' attribution rows are
+    # always available. ~200 bytes/row.
+    flight_steps: int = 256
+    # Online anomaly detection (step-time spikes/shifts, loss and
+    # grad-norm spikes — the latter two only with monitor_health=True).
+    # See the AnomalyConfig docstring.
+    anomaly_config: "AnomalyConfig" = dataclasses.field(
+        default_factory=lambda: AnomalyConfig())
     # sync=False only: gradient staleness bound k — each step applies
     # the gradients computed k steps earlier (deterministic SPMD
     # emulation of the reference's async PS, whose staleness was
@@ -397,6 +480,9 @@ class ParallaxConfig:
             raise ValueError(
                 f"trace_buffer_events must be >= 1, got "
                 f"{self.trace_buffer_events}")
+        if int(self.flight_steps) < 1:
+            raise ValueError(
+                f"flight_steps must be >= 1, got {self.flight_steps}")
         if self.shape_buckets is not None:
             # one validation rule, owned by compile/bucketing.py (the
             # lazy import keeps config importable before the package

@@ -68,6 +68,23 @@ def peak_flops_per_chip(device_kind: str,
     return None
 
 
+def device_peak_flops(platform: str, device_kind: str,
+                      gen_hint: Optional[str] = None
+                      ) -> Optional[float]:
+    """Per-chip bf16 peak FLOP/s for the RUNNING backend, or None.
+
+    The one platform gate shared by bench.py and the forensics
+    timeline (VERDICT r5 item 5): ``platform`` must be ``"tpu"`` —
+    a CPU/GPU fallback yields None, never a fabricated TPU number —
+    and the kind/hint then resolves against the published per-chip
+    table (v2..v6e). A TPU whose device_kind matches nothing known
+    also yields None (new hardware: no number beats a wrong one).
+    """
+    if platform != "tpu":
+        return None
+    return peak_flops_per_chip(device_kind, gen_hint)
+
+
 def mfu(flops_per_word: float, words_per_sec_per_chip: float,
         peak: Optional[float]) -> Optional[float]:
     """Model-FLOPs utilization of one chip, or None when the peak is

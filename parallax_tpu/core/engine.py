@@ -39,6 +39,8 @@ from __future__ import annotations
 
 import dataclasses
 import inspect
+import threading
+import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -309,6 +311,13 @@ class Engine:
         self._exec_misses = self.metrics.counter(
             "engine.executable_cache.misses")
         self.warmup_seconds: Dict[int, float] = {}
+        # per-thread H2D wall time of the LAST shard_batch on that
+        # thread (obs/timeline.py): the dispatch thread pops its own
+        # value after a step — a prefetch-thread placement (overlapped,
+        # off the critical path) can never leak into a dispatch row
+        self._h2d_tl = threading.local()
+        # cached XLA cost_analysis of the compiled step (forensics MFU)
+        self._step_costs: Optional[Dict[str, float]] = None
         # batch-shape buckets: pad ragged batches onto a declared
         # signature set (compile/bucketing.py) so retraces are bounded
         self._buckets = None
@@ -851,17 +860,69 @@ class Engine:
         tail (compile/bucketing.py) — full batches pass through
         bit-identical — so every caller (run / run_iter / place_batch /
         prefetch_to_device) presents a bounded signature set."""
-        with trace.span("engine.h2d_place"):
-            if self._buckets is not None and isinstance(batch, dict):
-                batch, _ = bucketing.bucket_batch(
-                    batch, self._buckets, self.config.bucket_mask_feed)
-            return self._shard_batch_impl(batch)
+        t0 = time.perf_counter()
+        try:
+            with trace.span("engine.h2d_place"):
+                if self._buckets is not None and isinstance(batch, dict):
+                    batch, _ = bucketing.bucket_batch(
+                        batch, self._buckets,
+                        self.config.bucket_mask_feed)
+                return self._shard_batch_impl(batch)
+        finally:
+            self._h2d_tl.seconds = time.perf_counter() - t0
 
     def _shard_batch_impl(self, batch):
         return place_host_batch(self.mesh, batch,
                                 overrides=self.model.batch_specs,
                                 transforms=self.model.feed_transforms,
                                 default_sharding_fn=self.batch_sharding_fn)
+
+    def pop_h2d_seconds(self) -> float:
+        """The calling thread's last ``shard_batch`` wall time, then 0
+        until its next placement — the dispatch thread's per-step H2D
+        share for the timeline (obs/timeline.py). Thread-local, so
+        overlapped prefetch-thread placements never count."""
+        s = getattr(self._h2d_tl, "seconds", 0.0)
+        self._h2d_tl.seconds = 0.0
+        return s
+
+    def step_cost_analysis(self, cheap_only: bool = True
+                           ) -> Dict[str, float]:
+        """XLA ``cost_analysis`` of one compiled train step (notably
+        ``flops`` — the numerator of the timeline's per-step MFU),
+        cached after the first resolution; {} when unavailable.
+
+        ``cheap_only=True`` (the monitoring path) only consults an
+        already-AOT-compiled executable (``warmup()``); with False
+        (flight dumps, explicit calls) the step is re-traced and
+        lowered from its example avals — a one-time host-side cost,
+        never a device execution."""
+        if self._step_costs is not None:
+            return self._step_costs
+        from parallax_tpu.common import compat
+        costs: Dict[str, float] = {}
+        try:
+            if self._executables:
+                costs = compat.cost_analysis(
+                    next(iter(self._executables.values())))
+            elif not cheap_only:
+                state_shapes = jax.eval_shape(
+                    self._init_jit,
+                    jax.ShapeDtypeStruct((), jnp.int32))
+                lowered = self._step_jit.lower(state_shapes,
+                                               self._batch_shapes)
+                # compat owns the list-vs-dict normalization (Lowered
+                # exposes the same cost_analysis() surface)
+                costs = compat.cost_analysis(lowered)
+            else:
+                return {}
+        except Exception as e:  # never fail training for forensics
+            parallax_log.warning("step cost analysis failed: %s", e)
+            # NOT cached: a transient failure must not permanently
+            # block the documented cheap_only=False retry path
+            return {}
+        self._step_costs = costs
+        return costs
 
     def sparse_wire_bytes_per_step(self) -> Dict[str, int]:
         """Bytes-on-wire per step for the sparse path vs the dense

@@ -71,7 +71,19 @@ class Prefetcher:
 
     def _worker(self):
         try:
-            for item in self._source:
+            while True:
+                # timed separately from prefetch.place so the chrome
+                # timeline (and a flight-dump reader) can tell a slow
+                # SOURCE (the data loader starving the pipeline) from
+                # slow PLACEMENT (feed conversion / H2D)
+                exhausted = False
+                with trace.span("prefetch.source_next"):
+                    try:
+                        item = next(self._source)
+                    except StopIteration:
+                        exhausted = True
+                if exhausted:
+                    break
                 if self._stop.is_set():
                     return
                 if self._place_fn is not None:

@@ -18,6 +18,22 @@ Three parts, one import:
     histogram and ``engine.executable_cache.*`` /
     ``session.engine_cache.*`` counters also live in the registry).
 
+The forensics layer (ISSUE 5) builds on the registry:
+
+  * :mod:`~parallax_tpu.obs.timeline` — per-step wall-time attribution
+    (data-wait / convert / H2D / dispatch / fetch-block / device
+    residual) + cost-analysis MFU and the goodput account.
+  * :mod:`~parallax_tpu.obs.flightrec` — always-on bounded history
+    dumped to a JSON artifact on crash, non-finite loss, serve SLO
+    breach, anomaly, or ``session.dump_flight()``
+    (``Config(flight_dir=...)`` arms the auto-dumps).
+  * :mod:`~parallax_tpu.obs.anomaly` — robust spike / change-point
+    detection on step time, loss and grad norm (``anomaly.*``
+    counters; each firing triggers a flight dump).
+  * :mod:`~parallax_tpu.obs.aggregate` — cross-process step-time
+    aggregation over the JAX coordinator channel; names the straggler
+    host in-artifact.
+
 ``disable()`` / ``enable()`` (or env ``PARALLAX_OBS=0``) switch the
 whole layer to near-free no-ops process-wide;
 `tools/check_obs_overhead.py` holds the enabled path to <=2% of step
@@ -25,17 +41,26 @@ wall-time.
 """
 
 from parallax_tpu.obs._state import disable, enable, is_enabled
-from parallax_tpu.obs import health, metrics, trace
+from parallax_tpu.obs import (aggregate, anomaly, flightrec, health,
+                              metrics, timeline, trace)
+from parallax_tpu.obs.aggregate import (aggregate_host_step_times,
+                                        find_stragglers)
+from parallax_tpu.obs.anomaly import AnomalyEvent, AnomalyMonitor
+from parallax_tpu.obs.flightrec import FlightRecorder
 from parallax_tpu.obs.health import HealthMonitor, device_memory_stats
 from parallax_tpu.obs.metrics import (Counter, Gauge, Histogram,
                                       JsonlSink, MetricsRegistry,
                                       PipelineStats)
+from parallax_tpu.obs.timeline import StepTimeline
 from parallax_tpu.obs.trace import (TraceCollector, TraceEvent,
                                     export_chrome_trace, span)
 
 __all__ = [
-    "trace", "metrics", "health", "span", "TraceCollector", "TraceEvent",
+    "trace", "metrics", "health", "timeline", "flightrec", "anomaly",
+    "aggregate", "span", "TraceCollector", "TraceEvent",
     "export_chrome_trace", "MetricsRegistry", "Counter", "Gauge",
     "Histogram", "JsonlSink", "PipelineStats", "HealthMonitor",
-    "device_memory_stats", "enable", "disable", "is_enabled",
+    "device_memory_stats", "StepTimeline", "FlightRecorder",
+    "AnomalyMonitor", "AnomalyEvent", "aggregate_host_step_times",
+    "find_stragglers", "enable", "disable", "is_enabled",
 ]

@@ -71,8 +71,25 @@ class HealthMonitor:
     the end of the run.
     """
 
-    def __init__(self, registry: MetricsRegistry, max_pending: int = 128):
+    def __init__(self, registry: MetricsRegistry, max_pending: int = 128,
+                 on_nonfinite=None, on_reading=None,
+                 readings_capacity: int = 256):
         self._registry = registry
+        # forensics hooks (obs/flightrec.py, obs/anomaly.py), invoked
+        # from _consume with already-materialized host floats:
+        #   on_nonfinite(step, kind)            kind in {"loss", "grad"}
+        #   on_reading(step, loss, grad_norm)   either value may be None
+        # Guarded — a broken hook must not corrupt health accounting.
+        self._on_nonfinite = on_nonfinite
+        self._on_reading = on_reading
+        # bounded ring of (step, loss, grad_norm, loss_finite) — the
+        # flight recorder's health section. Own lock: a flight dump
+        # snapshots it from another thread while _consume appends, and
+        # iterating a mutating deque raises — losing the health
+        # section of the very post-mortem the incident produced
+        self._readings_lock = threading.Lock()
+        self.readings: collections.deque = collections.deque(
+            maxlen=int(readings_capacity))
         self._lock = threading.Lock()
         # serializes pop+consume as one unit: concurrent pollers (the
         # dispatch thread and a metrics_snapshot from the sink thread)
@@ -80,7 +97,10 @@ class HealthMonitor:
         # the warning order could name the wrong step. observe() only
         # try-acquires it (skipping the drain under contention), so a
         # blocking report() can never stall the dispatch thread.
-        self._consume_lock = threading.Lock()
+        # REENTRANT: _consume fires the forensics hooks, and a flight
+        # dump's metrics provider polls health again on the same
+        # thread — a plain Lock would deadlock the incident path.
+        self._consume_lock = threading.RLock()
         self._pending: collections.deque = collections.deque()
         self._max_pending = int(max_pending)
         self._observed = registry.counter("health.steps_observed")
@@ -109,12 +129,14 @@ class HealthMonitor:
     # -- producer side (dispatch thread) -----------------------------------
 
     def observe(self, step: int, loss_finite=None,
-                grad_norm=None) -> None:
+                grad_norm=None, loss=None) -> None:
         """Queue one step's health outputs (device values ok); drains
         whatever is ready, never blocking on in-flight steps unless the
-        backlog exceeds ``max_pending``."""
+        backlog exceeds ``max_pending``. ``loss`` (optional) feeds the
+        forensics readings ring and the loss-spike detector — finiteness
+        accounting keys on ``loss_finite`` as before."""
         with self._lock:
-            self._pending.append((step, loss_finite, grad_norm))
+            self._pending.append((step, loss_finite, grad_norm, loss))
         # opportunistic drain: if another thread (report()/snapshot
         # poll) holds the consume lock, skip rather than wait — the
         # dispatch thread must never stall behind a blocking drain
@@ -155,27 +177,44 @@ class HealthMonitor:
             with self._lock:
                 if not self._pending:
                     return consumed
-                step, lf, gn = self._pending[0]
-                if not block and not (_is_ready(lf) and _is_ready(gn)):
+                step, lf, gn, loss = self._pending[0]
+                if not block and not (_is_ready(lf) and _is_ready(gn)
+                                      and _is_ready(loss)):
                     return consumed
                 self._pending.popleft()
-            self._consume(step, lf, gn)
+            self._consume(step, lf, gn, loss)
             consumed += 1
 
-    def _consume(self, step: int, loss_finite, grad_norm) -> None:
+    def _consume(self, step: int, loss_finite, grad_norm,
+                 loss=None) -> None:
         self._n_observed += 1
         self._observed.inc()
-        if loss_finite is not None:
-            finite = bool(np.asarray(loss_finite))
-            if not finite:
-                self._n_nonfinite_loss += 1
-                self._nonfinite_loss.inc()
-                if self.first_nonfinite_step is None:
-                    self.first_nonfinite_step = step
-                parallax_log.warning(
-                    "health: loss is non-finite at step %d", step)
-        if grad_norm is not None:
-            norm = float(np.asarray(grad_norm))
+        loss_f = None
+        if loss is not None:
+            loss_f = float(np.asarray(loss))
+        finite = (bool(np.asarray(loss_finite))
+                  if loss_finite is not None else None)
+        norm = (float(np.asarray(grad_norm))
+                if grad_norm is not None else None)
+        # the reading lands in the forensics ring BEFORE any incident
+        # hook fires: the flight dump a non-finite step triggers must
+        # already contain that step's reading
+        with self._readings_lock:
+            self.readings.append((step, loss_f, norm, finite))
+        if self._on_reading is not None:
+            try:
+                self._on_reading(step, loss_f, norm)
+            except Exception:
+                pass
+        if finite is False:
+            self._n_nonfinite_loss += 1
+            self._nonfinite_loss.inc()
+            if self.first_nonfinite_step is None:
+                self.first_nonfinite_step = step
+            parallax_log.warning(
+                "health: loss is non-finite at step %d", step)
+            self._fire_nonfinite(step, "loss")
+        if norm is not None:
             if np.isfinite(norm):
                 self._norms.append(norm)
                 self._n_norms += 1
@@ -187,6 +226,22 @@ class HealthMonitor:
                 parallax_log.warning(
                     "health: gradient global norm is non-finite at "
                     "step %d", step)
+                self._fire_nonfinite(step, "grad")
+
+    def _fire_nonfinite(self, step: int, kind: str) -> None:
+        if self._on_nonfinite is not None:
+            try:
+                self._on_nonfinite(step, kind)
+            except Exception:
+                pass
+
+    def recent_readings(self):
+        """JSON-ready copies of the readings ring (flight dumps)."""
+        with self._readings_lock:
+            readings = list(self.readings)
+        return [{"step": s, "loss": l, "grad_norm": g,
+                 "loss_finite": f}
+                for s, l, g, f in readings]
 
     def report(self) -> Dict:
         """Drain everything (blocking) and return the health summary."""

@@ -121,7 +121,7 @@ class RequestQueue:
     formation; shared by the one-shot micro-batcher and the
     continuous-decode scheduler."""
 
-    def __init__(self, max_queue: int, metrics=None):
+    def __init__(self, max_queue: int, metrics=None, on_timeout=None):
         self.max_queue = int(max_queue)
         self._items: List[Request] = []
         self._cond = threading.Condition()
@@ -133,6 +133,12 @@ class RequestQueue:
                           if metrics is not None else None)
         self._shed = (metrics.counter("serve.shed")
                       if metrics is not None else None)
+        # ``on_timeout(n)``: SLO-breach hook (the serve session points
+        # it at the flight recorder). Expiries are detected under the
+        # queue lock but reported OUTSIDE it (_report_expired) — the
+        # hook may do file I/O and must not stall producers/consumers.
+        self._on_timeout = on_timeout
+        self._expired_unreported = 0
 
     def __len__(self) -> int:
         with self._cond:
@@ -170,6 +176,7 @@ class RequestQueue:
             if r.deadline is not None and now > r.deadline:
                 if self._timeouts is not None:
                     self._timeouts.inc()
+                self._expired_unreported += 1
                 r._fail(DeadlineExceeded(
                     f"request {r.id} deadline expired after "
                     f"{now - r.t_enqueue:.3f}s in queue"))
@@ -178,21 +185,38 @@ class RequestQueue:
         self._items = kept
         self._set_depth_locked()
 
+    def _report_expired(self) -> None:
+        """Fire ``on_timeout`` for expiries detected since the last
+        report; called with the lock RELEASED."""
+        if self._on_timeout is None:
+            return
+        with self._cond:
+            n, self._expired_unreported = self._expired_unreported, 0
+        if n:
+            try:
+                self._on_timeout(n)
+            except Exception:
+                # forensics must never take the serving loop down
+                pass
+
     def pop(self, timeout: float = 0.05) -> Optional[Request]:
         """Oldest non-expired request, or None after ``timeout`` (also
         None immediately when closed and empty)."""
         end = time.perf_counter() + timeout
-        with self._cond:
-            while True:
-                now = time.perf_counter()
-                self._shed_expired_locked(now)
-                if self._items:
-                    req = self._items.pop(0)
-                    self._set_depth_locked()
-                    return req
-                if self._closed or now >= end:
-                    return None
-                self._cond.wait(min(0.02, max(0.0, end - now)))
+        try:
+            with self._cond:
+                while True:
+                    now = time.perf_counter()
+                    self._shed_expired_locked(now)
+                    if self._items:
+                        req = self._items.pop(0)
+                        self._set_depth_locked()
+                        return req
+                    if self._closed or now >= end:
+                        return None
+                    self._cond.wait(min(0.02, max(0.0, end - now)))
+        finally:
+            self._report_expired()
 
     def form_group(self, max_n: int, max_wait_s: float,
                    stop: threading.Event,
@@ -202,32 +226,36 @@ class RequestQueue:
         group is full, the oldest member has waited ``max_wait_s``, or
         the queue is draining (closed). Returns [] when there is
         nothing to serve yet (caller loops)."""
-        with self._cond:
-            now = time.perf_counter()
-            self._shed_expired_locked(now)
-            if not self._items:
-                if not (self._closed or stop.is_set()):
-                    self._cond.wait(poll_s)
-                    self._shed_expired_locked(time.perf_counter())
-                if not self._items:
-                    return []
-            key = self._items[0].group_key
-            dispatch_at = self._items[0].t_enqueue + max_wait_s
-        while True:
+        try:
             with self._cond:
                 now = time.perf_counter()
                 self._shed_expired_locked(now)
-                matching = [r for r in self._items if r.group_key == key]
-                full = len(matching) >= max_n
-                due = now >= dispatch_at
-                if full or due or self._closed or stop.is_set():
-                    take = matching[:max_n]
-                    for r in take:
-                        self._items.remove(r)
-                    self._set_depth_locked()
-                    return take
-                self._cond.wait(
-                    min(poll_s, max(0.001, dispatch_at - now)))
+                if not self._items:
+                    if not (self._closed or stop.is_set()):
+                        self._cond.wait(poll_s)
+                        self._shed_expired_locked(time.perf_counter())
+                    if not self._items:
+                        return []
+                key = self._items[0].group_key
+                dispatch_at = self._items[0].t_enqueue + max_wait_s
+            while True:
+                with self._cond:
+                    now = time.perf_counter()
+                    self._shed_expired_locked(now)
+                    matching = [r for r in self._items
+                                if r.group_key == key]
+                    full = len(matching) >= max_n
+                    due = now >= dispatch_at
+                    if full or due or self._closed or stop.is_set():
+                        take = matching[:max_n]
+                        for r in take:
+                            self._items.remove(r)
+                        self._set_depth_locked()
+                        return take
+                    self._cond.wait(
+                        min(poll_s, max(0.001, dispatch_at - now)))
+        finally:
+            self._report_expired()
 
     def close(self) -> None:
         """Stop admission; queued requests stay servable (drain)."""

@@ -94,12 +94,17 @@ class ContinuousScheduler:
 
     def __init__(self, program, params, serve_config, metrics,
                  queue: RequestQueue,
-                 name: str = "parallax-serve-decode"):
+                 name: str = "parallax-serve-decode",
+                 on_deadline_breach=None):
         self._program = program
         self._params = params
         self._sc = serve_config
         self._queue = queue
         self.metrics = metrics
+        # SLO-breach hook for MID-DECODE expiries (queued expiries go
+        # through the queue's own on_timeout); the serve session points
+        # it at the flight recorder
+        self._on_deadline_breach = on_deadline_breach
         self._S = int(serve_config.max_batch)
         self._ttft = metrics.histogram("serve.ttft_ms")
         self._latency = metrics.histogram("serve.request_latency_ms")
@@ -205,6 +210,7 @@ class ContinuousScheduler:
                           id=req.id, tokens=len(slot.tokens))
 
     def _expire_slots(self, now: float) -> None:
+        n_expired = 0
         for j, slot in enumerate(self._slots):
             if slot is None or slot.req.deadline is None:
                 continue
@@ -213,9 +219,16 @@ class ContinuousScheduler:
                 self._tok[j] = self._program.pad_id
                 self._t[j] = 0
                 self._timeouts.inc()
+                n_expired += 1
                 slot.req._fail(DeadlineExceeded(
                     f"request {slot.req.id} deadline expired mid-"
                     f"decode after {len(slot.tokens)} token(s)"))
+        if n_expired and self._on_deadline_breach is not None:
+            try:
+                self._on_deadline_breach(n_expired, where="decode")
+            except Exception:
+                # forensics must never take the decode loop down
+                pass
 
     def _fail_active(self, exc) -> None:
         """Fail every in-flight slot — called ONLY from the scheduler

@@ -80,7 +80,8 @@ class ServeSession:
                  ragged_feeds: Sequence[str] = (),
                  pad_value=0, warmup: bool = True,
                  program=None,
-                 metrics: Optional[obs_metrics.MetricsRegistry] = None):
+                 metrics: Optional[obs_metrics.MetricsRegistry] = None,
+                 flight=None):
         if jax.process_count() > 1:
             raise ValueError(
                 "ServeSession is single-process (each serving replica "
@@ -105,7 +106,14 @@ class ServeSession:
         self._batcher_ms = self.metrics.histogram(
             "serve.batcher_overhead_ms")
         self._h2d_ms = self.metrics.histogram("serve.h2d_ms")
-        self._queue = RequestQueue(sc.max_queue, self.metrics)
+        # flight recorder (obs/flightrec.py): a deadline/SLO breach is
+        # an incident worth a post-mortem — the training session's
+        # serve() handoff passes its recorder so the dump carries the
+        # shared registry's serve.* metrics next to the training state;
+        # a standalone ServeSession may pass its own (or None)
+        self._flight = flight
+        self._queue = RequestQueue(sc.max_queue, self.metrics,
+                                   on_timeout=self._on_deadline_breach)
         self._closed = False
         self._close_lock = threading.Lock()
 
@@ -114,7 +122,8 @@ class ServeSession:
             from parallax_tpu.serve.continuous import ContinuousScheduler
             self._params = self._place_params(params, model, program)
             self._scheduler = ContinuousScheduler(
-                program, self._params, sc, self.metrics, self._queue)
+                program, self._params, sc, self.metrics, self._queue,
+                on_deadline_breach=self._on_deadline_breach)
             self._batcher = None
             return
         self._scheduler = None
@@ -331,6 +340,18 @@ class ServeSession:
                 f"feed shapes or declare matching length_buckets")
         return Request(feed, deadline=deadline, group_key=group_key)
 
+    def _on_deadline_breach(self, n: int = 1,
+                            where: str = "queue") -> None:
+        """SLO-breach hook: every deadline expiry (queued, at dispatch,
+        or during service) triggers one rate-limited flight dump with
+        the serve.* metrics in-artifact."""
+        if self._flight is not None:
+            self._flight.trigger(
+                "serve_deadline_breach",
+                {"where": where, "n": int(n),
+                 "timeouts_total": self.metrics.counter(
+                     "serve.timeouts").value})
+
     # -- dispatch (batcher thread) ----------------------------------------
 
     def _run_batch(self, requests) -> None:
@@ -339,13 +360,17 @@ class ServeSession:
         # requests WAIT, but one can expire between dequeue and here —
         # don't spend device time on a caller who already gave up
         live = []
+        n_expired = 0
         for r in requests:
             if r.deadline is not None and t_host0 > r.deadline:
                 self.metrics.counter("serve.timeouts").inc()
+                n_expired += 1
                 r._fail(DeadlineExceeded(
                     f"request {r.id} deadline expired at dispatch"))
             else:
                 live.append(r)
+        if n_expired:
+            self._on_deadline_breach(n_expired, where="dispatch")
         requests = live
         if not requests:
             return
@@ -393,12 +418,14 @@ class ServeSession:
         leaves, treedef = jax.tree_util.tree_flatten(host)
         batched = [np.ndim(a) >= 1 for a in leaves]
         delivered = 0
+        n_late = 0
         for i, r in enumerate(requests):
             if r.deadline is not None and now > r.deadline:
                 # the step itself overran the budget: the deadline
                 # contract is "meet it or shed it", so a late result
                 # is DROPPED, never delivered (counted as a timeout)
                 self.metrics.counter("serve.timeouts").inc()
+                n_late += 1
                 r._fail(DeadlineExceeded(
                     f"request {r.id} missed its deadline by "
                     f"{(now - r.deadline) * 1e3:.1f}ms during service"))
@@ -410,6 +437,8 @@ class ServeSession:
             self._latency.record((now - r.t_enqueue) * 1e3)
             trace.record_span("serve.request", r.t_enqueue, now,
                               id=r.id, batch=bucket)
+        if n_late:
+            self._on_deadline_breach(n_late, where="service")
         self._completed.inc(delivered)
         self._batches.inc()
         self._occupancy.record(n / bucket)

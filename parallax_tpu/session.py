@@ -53,10 +53,13 @@ from parallax_tpu.compile import bucketing as bucketing_lib, \
     cache as compile_cache
 from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
 from parallax_tpu.checkpoint import CheckpointHook
-from parallax_tpu.obs import trace
+from parallax_tpu.obs import aggregate as aggregate_lib, trace
+from parallax_tpu.obs.anomaly import AnomalyMonitor
+from parallax_tpu.obs.flightrec import FlightRecorder
 from parallax_tpu.obs.health import HealthMonitor, device_memory_stats
 from parallax_tpu.obs.metrics import (JsonlSink, MetricsRegistry,
                                       PipelineStats)
+from parallax_tpu.obs.timeline import StepTimeline
 from parallax_tpu.profiler import ProfileHook
 from parallax_tpu.parallel.partitions import PartitionSearch
 
@@ -271,8 +274,36 @@ class ParallaxSession:
         self.metrics = MetricsRegistry()
         # async pipeline stats flow through the registry (pipeline.*)
         self.pipeline_stats = PipelineStats(self.metrics)
-        self.health = (HealthMonitor(self.metrics)
-                       if config.monitor_health else None)
+        # -- training forensics (obs/timeline, anomaly, flightrec) -----
+        # per-step wall-time attribution ring (also the flight
+        # recorder's step log)
+        self.timeline = StepTimeline(self.metrics,
+                                     capacity=config.flight_steps)
+        # thread-local step-phase scratch: data-wait/convert seconds
+        # measured before _run_step on the SAME thread that dispatches
+        self._phase = threading.local()
+        self.anomaly = AnomalyMonitor(self.metrics,
+                                      config.anomaly_config,
+                                      on_event=self._on_anomaly)
+        self._last_host_report: Optional[Dict] = None
+        self._flops_resolved = False
+        self.flight = FlightRecorder(
+            flight_dir=config.flight_dir, registry=self.metrics,
+            providers={
+                "progress": lambda: {"host_step": self._host_step},
+                "steps": self.timeline.rows,
+                "goodput": self._goodput_for_dump,
+                "anomalies": lambda: self.anomaly.events(),
+                "health": self._health_for_dump,
+                "host_report": lambda: self._last_host_report,
+                "metrics": self.metrics_snapshot,
+                "device_memory": device_memory_stats,
+                "config": self._config_summary,
+            })
+        self.health = (HealthMonitor(
+            self.metrics, on_nonfinite=self._on_nonfinite,
+            on_reading=self._on_health_reading)
+            if config.monitor_health else None)
         self._metrics_sink = (
             JsonlSink(self.metrics, config.metrics_path,
                       config.metrics_interval_s,
@@ -477,9 +508,24 @@ class ParallaxSession:
                 "search first (or disable search_partitions).")
         return self._run_iter_gen(iter(batches), fetches, placed)
 
+    def _next_timed(self, it):
+        """``next(it)`` with the wait attributed as the step's
+        data-wait (the input-stall lane of the timeline and the
+        chrome trace); StopIteration propagates."""
+        t0 = time.perf_counter()
+        try:
+            with trace.span("session.data_wait"):
+                return next(it)
+        finally:
+            self._phase.data_wait_s = time.perf_counter() - t0
+
     def _run_iter_gen(self, it, fetches, placed):
         if placed:
-            for batch in it:
+            while True:
+                try:
+                    batch = self._next_timed(it)
+                except StopIteration:
+                    return
                 # checked per batch, not at call time: the documented
                 # prefetch_to_device chaining builds the engine lazily
                 # on ITS background thread (place_batch), and the queue
@@ -492,7 +538,6 @@ class ParallaxSession:
                         "session.place_batch (which builds it) or "
                         "call prepare(example_feed) first")
                 yield self._run_step(fetches, batch, placed=True)
-            return
         # sequential while the partition search may rebuild the mesh
         while self._search is not None:
             try:
@@ -508,7 +553,11 @@ class ParallaxSession:
                                 name="parallax-feed-prefetch")
         self._prefetcher = prefetcher
         try:
-            for batch in prefetcher:
+            while True:
+                try:
+                    batch = self._next_timed(prefetcher)
+                except StopIteration:
+                    break
                 yield self._run_step(fetches, batch, placed=True)
         finally:
             prefetcher.close()
@@ -534,31 +583,73 @@ class ParallaxSession:
         """Dispatch one step on an already-converted (and possibly
         already-placed) batch; shared by run/run_async/run_iter."""
         step = self._host_step
+        # pop this thread's pre-dispatch phase measurements (run_iter's
+        # wait on the prefetcher, _convert_feed on this thread)
+        data_wait_s = getattr(self._phase, "data_wait_s", 0.0)
+        self._phase.data_wait_s = 0.0
+        convert_s = getattr(self._phase, "convert_s", 0.0)
+        self._phase.convert_s = 0.0
+        # placement this thread already paid before the step call (the
+        # place_batch-then-step pattern): part of this step's H2D, but
+        # NOT inside dt — popped separately so the dispatch share isn't
+        # corrupted by subtracting time it never contained
+        h2d_pre_s = (self._engine.pop_h2d_seconds()
+                     if self._engine is not None else 0.0)
         self._profile.before_step(step)
         t0 = time.perf_counter()
         gap = (None if self._last_dispatch_end is None
                else t0 - self._last_dispatch_end)
-        with trace.span("session.dispatch", step=step):
-            if not placed:
-                self.pipeline_stats.record_h2d(_feed_nbytes(batch))
-            self._state, outputs = self._engine.step(self._state, batch,
-                                                     preplaced=placed)
-            # debug_nans blocks too: its contract is "raise at the step
-            # that produced the NaN", which lazy fetches would defer to
-            # whatever later line first reads a value
-            blocking = (self._search is not None or self._profile.active
-                        or self._config.debug_nans
-                        or (self._config.eager_fetch and not force_lazy))
-            if blocking:
-                # Block so step timing / traces cover real device work.
-                tb = time.perf_counter()
-                outputs = {k: np.asarray(v) for k, v in outputs.items()}
-                self.pipeline_stats.record_blocked(
-                    time.perf_counter() - tb)
+        blocked_s = 0.0
+        try:
+            with trace.span("session.dispatch", step=step):
+                if not placed:
+                    self.pipeline_stats.record_h2d(_feed_nbytes(batch))
+                self._state, outputs = self._engine.step(
+                    self._state, batch, preplaced=placed)
+                # debug_nans blocks too: its contract is "raise at the
+                # step that produced the NaN", which lazy fetches would
+                # defer to whatever later line first reads a value
+                blocking = (self._search is not None
+                            or self._profile.active
+                            or self._config.debug_nans
+                            or (self._config.eager_fetch
+                                and not force_lazy))
+                if blocking:
+                    # Block so step timing / traces cover real device
+                    # work.
+                    tb = time.perf_counter()
+                    outputs = {k: np.asarray(v)
+                               for k, v in outputs.items()}
+                    blocked_s = time.perf_counter() - tb
+                    self.pipeline_stats.record_blocked(blocked_s)
+        except Exception as e:
+            # post-mortem without rerunning: the bounded history is
+            # dumped the moment a step dies (flight_dir configured);
+            # the exception itself propagates untouched
+            self.flight.trigger(
+                f"exception:{type(e).__name__}",
+                {"step": step, "error": f"{type(e).__name__}: {e}"})
+            raise
         now = time.perf_counter()
         dt = now - t0
         self._last_dispatch_end = now
         self.pipeline_stats.record_dispatch(gap, dt)
+        # step-time attribution (obs/timeline.py): wall = dispatch-end
+        # to dispatch-end; the engine's thread-local H2D share covers
+        # only a placement THIS thread just paid (preplaced batches
+        # overlapped it on the prefetch thread). The first step has no
+        # previous dispatch to anchor a gap, so its wall is its own
+        # measured pre-phases + dispatch (otherwise a step-0 data wait
+        # — the engine build — would exceed its wall and break the
+        # goodput fractions).
+        wall_s = (gap if gap is not None
+                  else data_wait_s + convert_s) + dt
+        self.timeline.record_step(
+            step, t0, wall_s, data_wait_s=data_wait_s,
+            convert_s=convert_s, h2d_s=self._engine.pop_h2d_seconds(),
+            dispatch_s=dt, fetch_block_s=blocked_s,
+            h2d_pre_s=h2d_pre_s)
+        self.anomaly.observe("step_time_ms", step, wall_s * 1e3)
         self._profile.after_step(step)
         self._last_outputs = outputs
         new_step = step + 1
@@ -570,12 +661,14 @@ class ParallaxSession:
             # ProfileHook numbering, so a NaN warning cross-references
             # the trace/profile of the step that produced it.
             self.health.observe(step, outputs.get("loss_finite"),
-                                outputs.get("grad_norm"))
+                                outputs.get("grad_norm"),
+                                loss=outputs.get("loss"))
         if self._ckpt.maybe_save(new_step, self._state):
             self._warn_sparse_overflow("checkpoint")
         if self._search is not None:
             self._record_search_time(dt)
-        return self._convert_fetch(fetches, outputs, lazy=not blocking)
+        return self._convert_fetch(fetches, outputs, lazy=not blocking,
+                                   step=step)
 
     @property
     def state(self):
@@ -630,6 +723,130 @@ class ParallaxSession:
                 pass
         return self.metrics.snapshot()
 
+    # -- training forensics (obs/) ----------------------------------------
+
+    def _on_anomaly(self, event) -> None:
+        """AnomalyMonitor callback: log + flight-dump the incident."""
+        parallax_log.warning(
+            "anomaly: %s %s at step %d — value %.4g vs baseline %.4g "
+            "(%.2fx)", event.signal, event.kind, event.step, event.value,
+            event.baseline, event.ratio)
+        self.flight.trigger(
+            f"anomaly_{event.signal}_{event.kind}",
+            {"signal": event.signal, "kind": event.kind,
+             "step": event.step, "value": event.value,
+             "baseline": event.baseline, "ratio": event.ratio})
+
+    def _on_nonfinite(self, step: int, kind: str) -> None:
+        """HealthMonitor callback: a NaN/Inf loss or grad norm is a
+        flight-dump incident the moment it is consumed."""
+        self.flight.trigger(f"nonfinite_{kind}", {"step": step})
+
+    def _on_health_reading(self, step: int, loss, grad_norm) -> None:
+        """Finite per-step health values feed the spike detectors."""
+        if loss is not None and np.isfinite(loss):
+            self.anomaly.observe("loss", step, float(loss))
+        if grad_norm is not None and np.isfinite(grad_norm):
+            self.anomaly.observe("grad_norm", step, float(grad_norm))
+
+    def step_flops(self, cheap_only: bool = True) -> Optional[float]:
+        """XLA cost-analysis FLOPs of one compiled step, or None.
+        ``cheap_only=True`` only reads an already-AOT-compiled
+        executable (free); False allows a one-time re-trace+lower."""
+        if self._engine is None:
+            return None
+        costs = self._engine.step_cost_analysis(cheap_only=cheap_only)
+        flops = costs.get("flops")
+        return float(flops) if flops else None
+
+    def _ensure_flops(self, cheap_only: bool = True) -> None:
+        """Attach FLOPs + device peak to the timeline once available,
+        so per-step MFU appears in rows/goodput/dumps. Null stays null
+        (CPU, unknown chip) — never fabricated."""
+        if self._flops_resolved or self._engine is None:
+            return
+        flops = self.step_flops(cheap_only=cheap_only)
+        if flops is None:
+            return
+        from parallax_tpu.common import flops as flops_lib
+        import os as _os
+        dev = jax.devices()[0]
+        peak = flops_lib.device_peak_flops(
+            dev.platform, getattr(dev, "device_kind", ""),
+            _os.environ.get("PALLAS_AXON_TPU_GEN"))
+        total_peak = peak * jax.device_count() if peak else None
+        self.timeline.set_flops(flops, total_peak)
+        self._flops_resolved = True
+
+    def _goodput_for_dump(self) -> Dict:
+        # cheap-only: a crash dump must not re-trace the model; with
+        # warmup() used (the bench path) the AOT executable makes this
+        # free, otherwise MFU just stays null in the artifact
+        self._ensure_flops(cheap_only=True)
+        return self.timeline.goodput()
+
+    def _health_for_dump(self) -> Optional[Dict]:
+        """Non-blocking health section: a flight dump must never hang
+        on a wedged device draining pending readings."""
+        if self.health is None:
+            return None
+        h = self.health
+        return {
+            "healthy": h.healthy,
+            "first_nonfinite_step": h.first_nonfinite_step,
+            "readings": h.recent_readings(),
+        }
+
+    def _config_summary(self) -> Dict:
+        cfg = self._config
+        import dataclasses as _dc
+        return {
+            "run_option": cfg.run_option,
+            "sparse_grad_mode": cfg.sparse_grad_mode,
+            "sync": cfg.sync,
+            "shape_buckets": (list(cfg.shape_buckets)
+                              if isinstance(cfg.shape_buckets,
+                                            (list, tuple))
+                              else cfg.shape_buckets),
+            "prefetch_depth": cfg.prefetch_depth,
+            "eager_fetch": cfg.eager_fetch,
+            "monitor_health": cfg.monitor_health,
+            "flight_dir": cfg.flight_dir,
+            "flight_steps": cfg.flight_steps,
+            "anomaly": _dc.asdict(cfg.anomaly_config),
+            "num_workers": self.num_workers,
+            "worker_id": self.worker_id,
+        }
+
+    def dump_flight(self, path: Optional[str] = None,
+                    reason: str = "manual") -> str:
+        """Write a flight-recorder post-mortem artifact NOW (the last
+        ``Config.flight_steps`` steps' attribution rows, health
+        readings, anomaly events, metrics snapshot, straggler report
+        when taken) and return its path. Unlike the automatic incident
+        triggers this works without ``Config.flight_dir`` (``path``
+        defaults into it when set, else the CWD)."""
+        return self.flight.dump(reason, path=path)
+
+    def aggregate_host_steps(self, factor: float = 1.25) -> Dict:
+        """COLLECTIVE (all processes must call): gather every host's
+        recent step-time stats over the JAX coordinator channel and
+        return the per-host table with any straggler NAMED
+        (``obs/aggregate.py``). The report lands in subsequent flight
+        dumps; a named straggler also counts into
+        ``anomaly.stragglers`` and triggers a flight dump."""
+        report = aggregate_lib.aggregate_host_step_times(
+            self.timeline.local_stats(), factor=factor)
+        self._last_host_report = report
+        line = aggregate_lib.straggler_summary(report)
+        if line is not None:
+            self.metrics.counter("anomaly.stragglers").inc(
+                len(report["stragglers"]))
+            parallax_log.warning("%s", line)
+            self.flight.trigger("straggler",
+                                {"summary": line, "report": report})
+        return report
+
     # -- compile-ahead engine (compile/) ----------------------------------
 
     def warmup(self, feed_dict: Optional[Dict[str, Any]] = None,
@@ -656,12 +873,17 @@ class ParallaxSession:
                 "prepare(example_feed)) first")
         if not background:
             with trace.span("session.warmup"):
-                return self._engine.warmup(self._state, batch_sizes)
+                stats = self._engine.warmup(self._state, batch_sizes)
+            # the AOT executable makes cost-analysis FLOPs free: attach
+            # them (and the chip peak) so per-step MFU starts flowing
+            self._ensure_flops(cheap_only=True)
+            return stats
 
         def _bg():
             try:
                 with trace.span("session.warmup", background=True):
                     self._engine.warmup(self._state, batch_sizes)
+                self._ensure_flops(cheap_only=True)
             except Exception as e:  # warmup is an optimization: a
                 # failure must never kill the training process
                 parallax_log.warning("background warmup failed: %s", e)
@@ -720,6 +942,7 @@ class ParallaxSession:
             raise ValueError(
                 "serve() needs a built engine: call "
                 "prepare(example_feed) (or run a step) first")
+        kw.setdefault("flight", self.flight)
         return ServeSession(infer_fn, self._state.params,
                             program=program, config=self._config,
                             mesh=self._engine.mesh, metrics=self.metrics,
@@ -774,8 +997,14 @@ class ParallaxSession:
     # -- feed/fetch conversion (session_context.py:179-233 parity) --------
 
     def _convert_feed(self, feed_dict):
-        with trace.span("session.convert_feed"):
-            return self._convert_feed_impl(feed_dict)
+        t0 = time.perf_counter()
+        try:
+            with trace.span("session.convert_feed"):
+                return self._convert_feed_impl(feed_dict)
+        finally:
+            # per-thread: a prefetch-thread conversion (overlapped, off
+            # the critical path) never lands in a dispatch-thread row
+            self._phase.convert_s = time.perf_counter() - t0
 
     def _convert_feed_impl(self, feed_dict):
         batch = {}
@@ -793,9 +1022,15 @@ class ParallaxSession:
         self._last_example_batch = batch
         return batch
 
-    def _convert_fetch(self, fetches, outputs, lazy: bool = False):
+    def _convert_fetch(self, fetches, outputs, lazy: bool = False,
+                       step: Optional[int] = None):
         if lazy:
-            record = self.pipeline_stats.record_blocked
+            def record(seconds, _step=step):
+                self.pipeline_stats.record_blocked(seconds)
+                if _step is not None:
+                    # attribute the lazy materialization back to the
+                    # step whose value it was (obs/timeline.py)
+                    self.timeline.add_fetch_block(_step, seconds)
             wrap = lambda v: Fetch(v, record)  # noqa: E731
         else:
             wrap = _to_host

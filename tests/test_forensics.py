@@ -1,0 +1,642 @@
+"""Training forensics (ISSUE 5): step-time attribution timeline,
+flight recorder dump triggers (crash / non-finite loss / serve SLO
+breach / explicit), anomaly + straggler detection, the bench
+regression gate, and the device-peak-FLOPs table under a TPU stub."""
+
+import glob
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import parallax_tpu as parallax
+from parallax_tpu import obs
+from parallax_tpu.common import flops as flops_lib
+from parallax_tpu.common.config import AnomalyConfig
+from parallax_tpu.models import simple
+from parallax_tpu.obs import aggregate
+from parallax_tpu.obs.anomaly import AnomalyMonitor
+from parallax_tpu.obs.flightrec import FlightRecorder
+from parallax_tpu.obs.metrics import MetricsRegistry
+from parallax_tpu.obs.timeline import StepTimeline
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _simple_session(**cfg_kw):
+    sess, *_ = parallax.parallel_run(
+        simple.build_model(learning_rate=0.1),
+        parallax_config=parallax.Config(run_option="AR",
+                                        search_partitions=False,
+                                        **cfg_kw))
+    return sess
+
+
+def _batches(n, batch=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return [simple.make_batch(rng, batch) for _ in range(n)]
+
+
+# -- step-time attribution (obs/timeline.py) -------------------------------
+
+
+class TestStepTimeline:
+    def test_rows_components_and_residual(self):
+        tl = StepTimeline(MetricsRegistry(), capacity=8)
+        tl.record_step(0, ts=0.0, wall_s=0.010, data_wait_s=0.002,
+                       convert_s=0.001, h2d_s=0.001, dispatch_s=0.004,
+                       fetch_block_s=0.001)
+        (row,) = tl.rows()
+        assert row["wall_ms"] == pytest.approx(10.0)
+        assert row["data_wait_ms"] == pytest.approx(2.0)
+        # dispatch is net of its inner h2d + fetch-block shares
+        assert row["dispatch_ms"] == pytest.approx(2.0)
+        attributed = (row["data_wait_ms"] + row["convert_ms"]
+                      + row["h2d_ms"] + row["dispatch_ms"]
+                      + row["fetch_block_ms"])
+        assert row["device_est_ms"] == pytest.approx(10.0 - attributed)
+        assert row["mfu"] is None  # no flops attached
+
+    def test_ring_eviction_and_fetch_block_attribution(self):
+        tl = StepTimeline(MetricsRegistry(), capacity=4)
+        for s in range(10):
+            tl.record_step(s, ts=float(s), wall_s=0.01,
+                           dispatch_s=0.01)
+        rows = tl.rows()
+        assert [r["step"] for r in rows] == [6, 7, 8, 9]
+        assert tl.total_rows == 10
+        # lazy fetch attributed back to its (still-ringed) step
+        tl.add_fetch_block(8, 0.005)
+        row8 = next(r for r in tl.rows() if r["step"] == 8)
+        assert row8["fetch_block_ms"] == pytest.approx(5.0)
+        # an evicted step's fetch-block is dropped, not crashed on
+        tl.add_fetch_block(0, 0.005)
+
+    def test_pre_dispatch_h2d_not_subtracted_from_dispatch(self):
+        """The place-batch-then-step pattern: placement paid BEFORE the
+        step call counts as H2D but must not be subtracted from a
+        dispatch share that never contained it."""
+        tl = StepTimeline(MetricsRegistry(), capacity=4)
+        tl.record_step(0, ts=0.0, wall_s=0.020, dispatch_s=0.004,
+                       h2d_pre_s=0.010)
+        (row,) = tl.rows()
+        assert row["h2d_ms"] == pytest.approx(10.0)
+        assert row["dispatch_ms"] == pytest.approx(4.0)  # not clamped
+
+    def test_mfu_and_goodput_account(self):
+        tl = StepTimeline(MetricsRegistry(), capacity=8)
+        for s in range(4):
+            tl.record_step(s, ts=0.0, wall_s=0.010, data_wait_s=0.002,
+                           dispatch_s=0.003)
+        # 1e9 FLOPs per 10ms step against a 1e12 FLOP/s peak = 0.1 MFU
+        tl.set_flops(1e9, 1e12)
+        rows = tl.rows()
+        assert rows[-1]["mfu"] == pytest.approx(0.1)
+        g = tl.goodput()
+        assert g["steps"] == 4
+        assert g["mfu_mean"] == pytest.approx(0.1)
+        assert g["phase_frac"]["data_wait_ms"] == pytest.approx(0.2)
+        fracs = sum(v for v in g["phase_frac"].values())
+        assert fracs == pytest.approx(1.0, abs=1e-6)
+        json.dumps(g)  # JSON-ready
+
+    def test_registry_gauges_and_disabled_noop(self):
+        reg = MetricsRegistry()
+        tl = StepTimeline(reg, capacity=8)
+        tl.record_step(0, ts=0.0, wall_s=0.01, dispatch_s=0.004)
+        snap = reg.snapshot()
+        assert snap["timeline.wall_ms"]["p50"] == pytest.approx(10.0)
+        assert snap["timeline.steps"] == 1
+        obs.disable()
+        try:
+            tl.record_step(1, ts=0.0, wall_s=0.01)
+            tl.add_fetch_block(0, 1.0)
+        finally:
+            obs.enable()
+        assert tl.total_rows == 1
+        assert tl.rows()[0]["fetch_block_ms"] == 0.0
+
+
+# -- anomaly detection (obs/anomaly.py) ------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(window=32, min_samples=8, spike_mads=6.0,
+                spike_min_ratio=2.0, shift_window=4, shift_ratio=1.5,
+                cooldown=16)
+    base.update(kw)
+    return AnomalyConfig(**base)
+
+
+class TestAnomaly:
+    def test_spike_fires_and_counts(self):
+        reg = MetricsRegistry()
+        am = AnomalyMonitor(reg, _cfg())
+        for i in range(20):
+            assert am.observe("step_time_ms", i,
+                              10.0 + 0.1 * (i % 3)) is None
+        ev = am.observe("step_time_ms", 20, 200.0)
+        assert ev is not None and ev.kind == "spike"
+        assert ev.step == 20 and ev.ratio > 10
+        assert reg.counter("anomaly.step_time_ms.spikes").value == 1
+        assert am.events()[0]["signal"] == "step_time_ms"
+
+    def test_cooldown_suppresses_repeat_firing(self):
+        am = AnomalyMonitor(MetricsRegistry(), _cfg(cooldown=16))
+        for i in range(20):
+            am.observe("s", i, 10.0)
+        assert am.observe("s", 20, 300.0) is not None
+        # within cooldown: an equal outlier stays silent
+        assert am.observe("s", 21, 300.0) is None
+
+    def test_shift_detects_sustained_regression_and_rebaselines(self):
+        reg = MetricsRegistry()
+        am = AnomalyMonitor(reg, _cfg(spike_min_ratio=10.0))
+        for i in range(30):
+            am.observe("s", i, 10.0 + 0.01 * (i % 5))
+        # a sustained 1.8x level change (no single sample is a spike
+        # at spike_min_ratio=10): the change-point detector must fire
+        fired = None
+        for i in range(30, 50):
+            ev = am.observe("s", i, 18.0)
+            if ev is not None:
+                fired = ev
+                break
+        assert fired is not None and fired.kind == "shift"
+        # fires as soon as the recent mean crosses shift_ratio x the
+        # baseline (the mean still mixes a few old-level samples)
+        assert fired.ratio >= 1.5
+        assert fired.baseline == pytest.approx(10.0, rel=0.05)
+        assert reg.counter("anomaly.s.shifts").value == 1
+        # rebaselined: the new level is now normal — no refiring even
+        # after cooldown expires
+        for i in range(50, 120):
+            assert am.observe("s", i, 18.0) is None
+
+    def test_stable_signal_never_fires_and_disabled_noop(self):
+        am = AnomalyMonitor(MetricsRegistry(), _cfg())
+        for i in range(200):
+            assert am.observe("s", i, 5.0 + 0.05 * (i % 7)) is None
+        obs.disable()
+        try:
+            n = am.total_observed
+            am.observe("s", 999, 1e9)
+        finally:
+            obs.enable()
+        assert am.total_observed == n
+
+    def test_on_event_callback(self):
+        got = []
+        am = AnomalyMonitor(MetricsRegistry(), _cfg(),
+                            on_event=got.append)
+        for i in range(20):
+            am.observe("s", i, 1.0)
+        am.observe("s", 20, 50.0)
+        assert len(got) == 1 and got[0].kind == "spike"
+
+
+# -- flight recorder (obs/flightrec.py) ------------------------------------
+
+
+class TestFlightRecorder:
+    def test_dump_sections_and_provider_isolation(self, tmp_path):
+        def boom():
+            raise RuntimeError("poisoned buffer")
+        fr = FlightRecorder(
+            flight_dir=str(tmp_path),
+            providers={"good": lambda: {"x": 1}, "bad": boom})
+        path = fr.dump("manual", detail={"k": "v"})
+        doc = json.load(open(path))
+        assert doc["reason"] == "manual"
+        assert doc["detail"] == {"k": "v"}
+        assert doc["good"] == {"x": 1}
+        assert "RuntimeError" in doc["bad"]["_error"]
+        assert doc["process_index"] == 0
+
+    def test_trigger_requires_flight_dir_and_dedups(self, tmp_path):
+        fr = FlightRecorder(flight_dir=None)
+        assert fr.trigger("nonfinite_loss") is None  # not armed
+        fr = FlightRecorder(flight_dir=str(tmp_path))
+        p1 = fr.trigger("nonfinite_loss:a", {"step": 1})
+        assert p1 is not None
+        # same reason KEY: suppressed (one artifact per incident class)
+        assert fr.trigger("nonfinite_loss:b", {"step": 2}) is None
+        # a different incident class still dumps
+        assert fr.trigger("serve_deadline_breach") is not None
+        assert len(fr.dump_paths) == 2
+
+    def test_max_dumps_cap(self, tmp_path):
+        fr = FlightRecorder(flight_dir=str(tmp_path), max_dumps=2)
+        assert fr.trigger("a") and fr.trigger("b")
+        assert fr.trigger("c") is None
+        assert len(fr.dump_paths) == 2
+
+
+# -- straggler aggregation (obs/aggregate.py) ------------------------------
+
+
+class TestAggregate:
+    def test_find_stragglers(self):
+        assert aggregate.find_stragglers([10, 10, 10, 10]) == []
+        assert aggregate.find_stragglers([10, 31, 10, 10],
+                                         factor=1.25) == [1]
+        assert aggregate.find_stragglers([10]) == []  # single host
+        assert aggregate.find_stragglers([10, 13, 40, 41],
+                                         factor=1.3) == [2, 3]
+
+    def test_build_report_names_the_laggard(self):
+        rows = np.array([[10.0, 12.0, 50], [41.0, 52.0, 50],
+                         [11.0, 13.0, 50]])
+        rep = aggregate.build_report(rows, factor=1.25)
+        assert rep["num_hosts"] == 3
+        assert rep["stragglers"] == [1]
+        assert rep["slowest"] == 1
+        assert rep["hosts"][1]["straggler"] is True
+        assert rep["hosts"][1]["vs_median"] == pytest.approx(
+            41 / 11.0, abs=1e-3)
+        line = aggregate.straggler_summary(rep)
+        assert "process 1" in line
+        assert aggregate.straggler_summary(
+            aggregate.build_report(np.array([[10.0, 11.0, 5],
+                                             [10.5, 11.0, 5]]))) is None
+        json.dumps(rep)
+
+    def test_single_process_collective(self):
+        rep = aggregate.aggregate_host_step_times(
+            {"mean_ms": 5.0, "p95_ms": 7.0, "steps": 12})
+        assert rep["num_hosts"] == 1
+        assert rep["stragglers"] == []
+        assert rep["hosts"][0]["steps"] == 12
+
+
+# -- session integration ---------------------------------------------------
+
+
+class TestSessionForensics:
+    def test_timeline_attribution_through_run_and_run_iter(self):
+        sess = _simple_session()
+        try:
+            sess.run("loss", feed_dict=_batches(1)[0])
+            (row,) = sess.timeline.rows()
+            # the run() path converts + places on the dispatch thread
+            assert row["convert_ms"] > 0
+            assert row["h2d_ms"] > 0
+            assert row["dispatch_ms"] > 0
+            for r in sess.run_iter(_batches(6), "loss"):
+                float(r)
+            rows = sess.timeline.rows()
+            assert len(rows) == 7
+            # preplaced batches: H2D overlapped on the prefetch thread,
+            # so the dispatch rows carry no critical-path H2D...
+            assert all(r["h2d_ms"] == 0.0 for r in rows[1:])
+            # ...and waiting on the prefetcher is attributed data-wait
+            assert any(r["data_wait_ms"] > 0 for r in rows[1:])
+            snap = sess.metrics_snapshot()
+            assert snap["timeline.steps"] == 7
+            assert snap["timeline.wall_ms"]["count"] == 7
+        finally:
+            sess.close()
+
+    def test_explicit_dump_flight_without_flight_dir(self, tmp_path):
+        sess = _simple_session()
+        try:
+            for b in _batches(3):
+                sess.run("loss", feed_dict=b)
+            path = sess.dump_flight(str(tmp_path / "post.json"))
+            doc = json.load(open(path))
+            assert doc["reason"] == "manual"
+            assert len(doc["steps"]) == 3
+            assert doc["goodput"]["steps"] == 3
+            assert doc["config"]["run_option"] == "AR"
+            assert doc["metrics"]["pipeline.steps"] == 3
+            assert doc["progress"]["host_step"] == 3
+        finally:
+            sess.close()
+
+    def test_crash_dump_on_step_exception(self, tmp_path):
+        """Acceptance: a crash escaping a step leaves a post-mortem
+        artifact (and the exception still propagates)."""
+        sess = _simple_session(flight_dir=str(tmp_path))
+        try:
+            for b in _batches(2):
+                sess.run("loss", feed_dict=b)
+            bad = {"x": _batches(1)[0]["x"]}  # missing the 'y' feed
+            with pytest.raises(Exception):
+                sess.run("loss", feed_dict=bad)
+            dumps = glob.glob(str(tmp_path / "flight_exception*.json"))
+            assert len(dumps) == 1
+            doc = json.load(open(dumps[0]))
+            assert doc["reason"].startswith("exception:")
+            assert doc["detail"]["step"] == 2
+            assert len(doc["steps"]) == 2  # the history before death
+        finally:
+            sess.close()
+
+    def test_nan_loss_triggers_flight_dump(self, tmp_path):
+        """Acceptance: an injected NaN loss produces a flight artifact
+        naming the step."""
+        sess = _simple_session(monitor_health=True,
+                               flight_dir=str(tmp_path))
+        try:
+            good = _batches(3)
+            bad = _batches(1, seed=9)[0]
+            bad["x"] = np.full_like(bad["x"], np.nan)
+            for b in (good[0], good[1], bad, good[2]):
+                sess.run("loss", feed_dict=b)
+            sess.health.poll(block=True)
+            dumps = glob.glob(str(tmp_path / "flight_nonfinite_loss*"))
+            assert len(dumps) == 1
+            doc = json.load(open(dumps[0]))
+            assert doc["detail"]["step"] == 2
+            assert doc["health"]["first_nonfinite_step"] == 2
+            readings = doc["health"]["readings"]
+            assert any(r["loss_finite"] is False for r in readings)
+        finally:
+            sess.close()
+
+    def test_step_flops_after_warmup_feeds_timeline(self):
+        sess = _simple_session()
+        try:
+            b = _batches(1)[0]
+            sess.warmup(feed_dict=b, batch_sizes=[64])
+            flops = sess.step_flops()  # cheap: AOT executable exists
+            assert flops is not None and flops > 0
+            # CPU: peak is None, so MFU must stay null — never faked
+            sess.run("loss", feed_dict=b)
+            assert sess.timeline.goodput()["flops_per_step"] == flops
+            assert sess.timeline.goodput()["mfu_mean"] is None
+        finally:
+            sess.close()
+
+    def test_place_batch_then_step_attributes_h2d(self):
+        """Same-thread sess.place_batch -> placed step: the placement
+        lands in the step's row as H2D without zeroing dispatch."""
+        sess = _simple_session()
+        try:
+            placed = sess.place_batch(_batches(1)[0])
+            (res,) = list(sess.run_iter(iter([placed]), "loss",
+                                        placed=True))
+            float(res)
+            (row,) = sess.timeline.rows()
+            assert row["h2d_ms"] > 0          # the pre-step placement
+            assert row["dispatch_ms"] > 0     # not clamped to zero
+        finally:
+            sess.close()
+
+    def test_step_flops_noncheap_retraces_when_no_executable(self):
+        sess = _simple_session()
+        try:
+            sess.run("loss", feed_dict=_batches(1)[0])
+            # no AOT executable: the cheap (monitoring) path refuses
+            assert sess.step_flops() is None
+            # the explicit path re-traces + lowers once and caches
+            f = sess.step_flops(cheap_only=False)
+            assert f is not None and f > 0
+            assert sess.step_flops() == f  # now cached, cheap too
+        finally:
+            sess.close()
+
+    def test_host_aggregation_lands_in_dump(self, tmp_path):
+        sess = _simple_session()
+        try:
+            for b in _batches(4):
+                sess.run("loss", feed_dict=b)
+            rep = sess.aggregate_host_steps()
+            assert rep["num_hosts"] == 1 and rep["stragglers"] == []
+            doc = json.load(open(sess.dump_flight(
+                str(tmp_path / "agg.json"))))
+            assert doc["host_report"]["num_hosts"] == 1
+        finally:
+            sess.close()
+
+
+# -- serve SLO breach trigger ----------------------------------------------
+
+
+class TestServeSLOBreachDump:
+    def test_deadline_breach_triggers_flight_dump(self, tmp_path):
+        """Acceptance: a serve deadline breach produces a flight
+        artifact (the queue sheds the expired request, the breach hook
+        fires through the recorder)."""
+        from parallax_tpu.serve import ServeSession
+        from parallax_tpu.serve.batcher import DeadlineExceeded
+        fr = FlightRecorder(flight_dir=str(tmp_path))
+        serve = ServeSession(
+            lambda params, batch: {"y": batch["x"]},
+            {"w": np.zeros((1,), np.float32)},
+            example_feed={"x": np.zeros((4,), np.float32)},
+            config=parallax.Config(serve_config=parallax.ServeConfig(
+                max_batch=2, max_wait_ms=30.0, max_queue=8)),
+            flight=fr)
+        try:
+            req = serve.submit({"x": np.ones((4,), np.float32)},
+                               deadline_ms=0.01)
+            deadline = time.perf_counter() + 10.0
+            while not req.done() and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            assert req.done()
+            with pytest.raises(DeadlineExceeded):
+                req.result()
+            # the breach hook fired a dump (queue or dispatch path)
+            ok = time.perf_counter() + 5.0
+            while not fr.dump_paths and time.perf_counter() < ok:
+                time.sleep(0.01)
+            dumps = glob.glob(
+                str(tmp_path / "flight_serve_deadline_breach*"))
+            assert len(dumps) == 1
+            doc = json.load(open(dumps[0]))
+            assert doc["detail"]["n"] >= 1
+        finally:
+            serve.close()
+
+
+# -- regression gate (tools/check_regression.py) ---------------------------
+
+
+def _bench_block(value=4000.0, version=2, sha="abc123", **kw):
+    block = {"metric": "lm1b_words_per_sec_per_chip", "value": value,
+             "unit": "words/sec/chip", "platform": "cpu", "n_chips": 8,
+             "bench_version": version,
+             "harness": {"bench_sha256": sha, "steps_measured": 30}}
+    block.update(kw)
+    return block
+
+
+class TestRegressionGate:
+    def _compare(self, cur, prev, **kw):
+        from tools.check_regression import compare
+        return compare(cur, prev, **kw)
+
+    def test_unchanged_rerun_passes(self):
+        r = self._compare(_bench_block(4000.0), _bench_block(4010.0))
+        assert r["status"] == "ok"
+        assert r["harness_verified"] is True
+
+    def test_catches_injected_2x_slowdown(self):
+        """Acceptance: a 2x step-time slowdown (headline halves)
+        between harness-compatible rounds FAILS the gate."""
+        r = self._compare(_bench_block(2000.0), _bench_block(4000.0))
+        assert r["status"] == "regression"
+        assert r["ratio"] == pytest.approx(0.5)
+
+    def test_regression_note_explains(self):
+        r = self._compare(
+            _bench_block(2000.0, regression_note="vocab doubled"),
+            _bench_block(4000.0))
+        assert r["status"] == "explained"
+
+    def test_version_bump_needs_ab_block(self):
+        cur = _bench_block(2000.0, version=3)
+        prev = _bench_block(4000.0, version=2)
+        r = self._compare(cur, prev)
+        assert r["status"] == "not_comparable"
+        assert "ab_vs_prev_harness" in r["why"]
+        # A/B shows the move is methodology: same build under prev
+        # params holds the old number -> explained
+        cur["ab_vs_prev_harness"] = {"value_under_prev_params": 3900.0}
+        r = self._compare(cur, prev)
+        assert r["status"] == "explained"
+        assert r["ab_ratio"] == pytest.approx(0.975)
+
+    def test_version_bump_cannot_amnesty_a_build_regression(self):
+        """The gate judges the A/B's apples-to-apples ratio: a build
+        that regressed 2x cannot hide behind a bench_version bump."""
+        cur = _bench_block(2000.0, version=3)
+        prev = _bench_block(4000.0, version=2)
+        cur["ab_vs_prev_harness"] = {"value_under_prev_params": 2000.0}
+        r = self._compare(cur, prev)
+        assert r["status"] == "regression"
+        assert r["ab_ratio"] == pytest.approx(0.5)
+        cur["regression_note"] = "accepted: bf16 accumulate change"
+        assert self._compare(cur, prev)["status"] == "explained"
+
+    def test_harness_edit_within_version_not_comparable(self):
+        r = self._compare(_bench_block(2000.0, sha="NEW"),
+                          _bench_block(4000.0, sha="OLD"))
+        assert r["status"] == "not_comparable"
+
+    def test_platform_or_chips_mismatch_not_comparable(self):
+        r = self._compare(_bench_block(8000.0, platform="tpu"),
+                          _bench_block(4000.0))
+        assert r["status"] == "not_comparable"
+
+    def test_failed_round_never_gates(self):
+        r = self._compare(_bench_block(0.0, error="worker exited"),
+                          _bench_block(4000.0))
+        assert r["status"] == "no_data"
+
+    def test_suspicious_rise_flagged_but_passes(self):
+        r = self._compare(_bench_block(9000.0), _bench_block(4000.0))
+        assert r["status"] == "suspicious_rise"
+
+    def test_main_on_wrapped_artifacts(self, tmp_path):
+        """End to end through the CLI against driver-format files:
+        unchanged rerun exits 0, injected 2x slowdown exits 1."""
+        from tools.check_regression import main
+        prev = tmp_path / "BENCH_r05.json"
+        cur = tmp_path / "BENCH_r06.json"
+        prev.write_text(json.dumps(
+            {"n": 5, "rc": 0, "parsed": _bench_block(4000.0)}))
+        cur.write_text(json.dumps(
+            {"n": 6, "rc": 0, "parsed": _bench_block(3900.0)}))
+        assert main([str(cur), str(prev)]) == 0
+        cur.write_text(json.dumps(
+            {"n": 6, "rc": 0, "parsed": _bench_block(2000.0)}))
+        assert main([str(cur), str(prev)]) == 1
+
+    def test_discovery_orders_by_round_number(self, tmp_path):
+        from tools.check_regression import discover_rounds
+        for n in (2, 10, 9):
+            (tmp_path / f"BENCH_r{n:02d}.json").write_text("{}")
+        cur, prev = discover_rounds(str(tmp_path))
+        assert cur.endswith("BENCH_r10.json")
+        assert prev.endswith("BENCH_r09.json")
+
+
+# -- device peak FLOPs under a TPU stub (VERDICT r5 item 5) ---------------
+
+
+class TestDevicePeakFlops:
+    def test_platform_gate_and_table(self):
+        f = flops_lib.device_peak_flops
+        assert f("cpu", "cpu") is None          # fallback: no number
+        assert f("gpu", "NVIDIA H100") is None
+        assert f("tpu", "TPU v4") == 275e12
+        assert f("tpu", "TPU v5e") == 197e12
+        assert f("tpu", "TPU v5p") == 459e12
+        assert f("tpu", "TPU v6 lite") == 918e12
+        # opaque kind + env gen hint resolves (the tunnel case)
+        assert f("tpu", "", "v5e") == 197e12
+        # unknown TPU: None, never a wrong number
+        assert f("tpu", "TPU v99") is None
+
+    def test_mfu_nonnull_the_moment_platform_is_tpu(self):
+        """bench.py's exact computation under a v5e stub: a non-null
+        MFU lands without any TPU-side special-casing."""
+        from parallax_tpu.models import lm1b
+        cfg = lm1b.tiny_config(num_partitions=8)
+        fpw = flops_lib.lm1b_matmul_flops_per_word(cfg)
+        peak = flops_lib.device_peak_flops("tpu", "TPU v5e", None)
+        value = flops_lib.mfu(fpw, 1e6, peak)
+        assert value is not None and 0 < value < 1
+        assert flops_lib.mfu(fpw, 1e6, None) is None  # CPU: null
+
+
+# -- bench harness A/B decision (VERDICT r5 item 6) ------------------------
+
+
+class TestBenchHarnessAB:
+    def test_needs_ab_only_on_version_bump_with_harness(self):
+        import bench
+        prev = {"bench_version": bench.BENCH_VERSION - 1,
+                "harness": {"batch_size": 128}}
+        assert bench._needs_harness_ab(prev)
+        assert not bench._needs_harness_ab(
+            {"bench_version": bench.BENCH_VERSION,
+             "harness": {"batch_size": 128}})
+        assert not bench._needs_harness_ab(
+            {"bench_version": bench.BENCH_VERSION - 1})  # no harness
+        assert not bench._needs_harness_ab(None)
+
+    def test_load_prev_round_unwraps_driver_format(self, tmp_path):
+        import bench
+        (tmp_path / "BENCH_r04.json").write_text(json.dumps(
+            {"parsed": {"value": 1.0, "bench_version": 1}}))
+        (tmp_path / "BENCH_r05.json").write_text(json.dumps(
+            {"parsed": {"value": 2.0, "bench_version": 2}}))
+        prev = bench._load_prev_round(str(tmp_path))
+        assert prev == {"value": 2.0, "bench_version": 2}
+        assert bench._load_prev_round(str(tmp_path / "none")) is None
+
+
+# -- bench_resnet tracking number (VERDICT r5 item 5) ----------------------
+
+
+class TestResnetVsPrev:
+    def _result(self, **kw):
+        base = {"value": 0.1, "platform": "cpu", "n_chips": 8,
+                "model": "resnet50_v1.5", "image_size": 224,
+                "classes": 1000, "per_chip_batch": 2}
+        base.update(kw)
+        return base
+
+    def test_comparable_rounds_track(self):
+        from tools.bench_resnet import vs_prev
+        ratio, why = vs_prev(self._result(value=0.05),
+                             self._result(value=0.1))
+        assert ratio == pytest.approx(0.5)  # the 2x regression shows
+        assert why == "comparable"
+
+    def test_shape_or_platform_change_never_fakes_a_ratio(self):
+        from tools.bench_resnet import vs_prev
+        ratio, why = vs_prev(self._result(),
+                             self._result(image_size=64))
+        assert ratio is None and "image_size" in why
+        ratio, why = vs_prev(self._result(),
+                             self._result(platform="tpu"))
+        assert ratio is None
+        assert vs_prev(self._result(), None)[0] is None
+        assert vs_prev(self._result(), self._result(value=0))[0] is None

@@ -103,6 +103,54 @@ def test_elastic_restart_resumes_from_checkpoint(tmp_path):
 
 
 @pytest.mark.slow
+def test_straggler_host_named_in_aggregated_artifact(tmp_path):
+    """Forensics acceptance (ISSUE 5): 2 processes, worker 1 with an
+    injected per-step host delay — the cross-process aggregation over
+    the coordinator channel must NAME the delayed host in the report
+    every process receives AND in the flight-dump artifact."""
+    import json
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out = str(tmp_path / "straggler")
+    flight_dir = str(tmp_path / "flight")
+    os.makedirs(flight_dir, exist_ok=True)
+    env = dict(os.environ)
+    env.update({
+        "PARALLAX_COORDINATOR_PORT": str(port),
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": os.getcwd() + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.pop("PARALLAX_RUN_OPTION", None)
+    proc = subprocess.run(
+        [sys.executable, "tests/multihost_straggler_driver.py", out,
+         flight_dir],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+
+    results = {}
+    for wid in (0, 1):
+        path = f"{out}.worker{wid}"
+        assert os.path.exists(path), proc.stderr[-2000:]
+        results[wid] = json.load(open(path))
+    # every process received the same verdict: process 1 is the
+    # straggler, by name
+    for wid, doc in results.items():
+        rep = doc["report"]
+        assert rep["num_hosts"] == 2, rep
+        assert rep["stragglers"] == [1], rep
+        assert rep["hosts"][1]["straggler"] is True
+        assert rep["hosts"][1]["mean_ms"] > rep["hosts"][0]["mean_ms"]
+    # and the flight artifact carries the named straggler in-file
+    for wid, doc in results.items():
+        flight = json.load(open(doc["flight_path"]))
+        assert flight["host_report"]["stragglers"] == [1], \
+            flight["host_report"]
+        assert flight["process_index"] == wid
+
+
+@pytest.mark.slow
 def test_two_process_launch_and_training(tmp_path):
     import socket
     with socket.socket() as s:  # grab a free port; avoids collisions

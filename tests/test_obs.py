@@ -575,11 +575,13 @@ class TestLoggingConfig:
 
 
 def test_obs_overhead_within_budget():
-    """tools/check_obs_overhead.py: the instrumented step loop stays
-    within 2% of uninstrumented wall-time on the simple model. The
-    decomposed measurement (see the tool's docstring) is deterministic
-    up to microbench jitter; two attempts absorb a pathological
-    scheduling spike."""
+    """tools/check_obs_overhead.py: the instrumented step loop —
+    including the forensics layer's per-step timeline row and anomaly
+    observation (ISSUE 5) — stays within 2% of uninstrumented
+    wall-time on the simple model, and the kill switch still silences
+    everything. The decomposed measurement (see the tool's docstring)
+    is deterministic up to microbench jitter; two attempts absorb a
+    pathological scheduling spike."""
     import sys, os
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     from tools.check_obs_overhead import measure
@@ -591,3 +593,7 @@ def test_obs_overhead_within_budget():
             break
     assert last["overhead_frac"] <= 0.02, last
     assert last["obs_us_per_step"] > 0  # it did measure something
+    # the new per-step instruments were actually seen and priced
+    assert last["timeline_rows_per_step"] >= 1, last
+    assert last["anomaly_obs_per_step"] >= 1, last
+    assert last["killswitch_clean"], last

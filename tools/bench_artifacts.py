@@ -1,0 +1,62 @@
+"""Shared helpers for per-round bench artifacts.
+
+One implementation of the two conventions every bench consumer needs
+(bench.py's previous-round loader, tools/check_regression.py's gate,
+tools/bench_resnet.py's tracking number), so a change to the artifact
+layout happens in one place:
+
+* **round files** — ``<PREFIX>r<NN>.json``, ordered by round NUMBER
+  (a lexical sort would put r10 before r9);
+* **the driver wrapper** — repo-root artifacts arrive as
+  ``{"n": ..., "rc": ..., "parsed": {<the bench JSON line>}}``; tools
+  must accept both the wrapper and the raw line.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import List, Optional, Tuple
+
+
+def round_number(path: str) -> int:
+    """The NN of a ``..._rNN.json`` path, or -1 when it has none."""
+    m = re.search(r"_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def round_paths(directory: str, prefix: str = "BENCH_") -> List[str]:
+    """Every ``<prefix>r<NN>.json`` in ``directory``, ascending by
+    round number."""
+    paths = glob.glob(os.path.join(directory, prefix + "r*.json"))
+    return sorted((p for p in paths if round_number(p) >= 0),
+                  key=round_number)
+
+
+def load_block(path: str) -> Optional[dict]:
+    """The bench result block from ``path`` — unwraps the driver
+    format; None when unreadable or structurally not a result."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "value" in parsed:
+        return parsed
+    return doc if "value" in doc else None
+
+
+def latest_rounds(directory: str, prefix: str = "BENCH_"
+                  ) -> Tuple[Optional[str], Optional[str]]:
+    """(current, previous) paths by round number; None when absent."""
+    paths = round_paths(directory, prefix)
+    if not paths:
+        return None, None
+    if len(paths) == 1:
+        return paths[0], None
+    return paths[-1], paths[-2]

@@ -4,20 +4,92 @@ steps/sec + images/sec:
 /root/reference/parallax/parallax/examples/tf_cnn_benchmarks/
 CNNBenchmark_distributed_driver.py:85-91).
 
-Writes perf/BENCH_RESNET_r05.json with the platform stamped, same
-honesty contract as bench.py: a CPU fallback can never masquerade as a
-TPU number. On TPU the realistic config is per-chip batch 64, v1.5,
-bf16 batch; on CPU a tiny image/batch smoke keeps the artifact cheap
-while still measuring the real engine path (dense AR, BatchNorm state
-flow).
+VERDICT r5 item 5: this number must TRACK — constant shapes round over
+round so a 2× regression in the conv/BatchNorm path is caught like
+LM1B's. The measured configuration is therefore fixed: **224 px,
+ResNet-50 v1.5, 1000 classes, a constant per-chip batch** on every
+platform (the old 64 px CPU "structure smoke" tracked nothing). Steps
+are fewer on CPU, but the per-step work — the compiled program — is
+shape-identical across rounds.
+
+Each run writes ``perf/BENCH_RESNET_r<NN>.json`` (NN = next round) with
+a ``harness`` block (shapes, steps, tool hash) and a ``vs_prev`` ratio
+against the latest previous round whose harness is shape-compatible and
+whose platform/chip-count match — the LM1B-style tracking number.
+``vs_prev`` stays null (never fabricated) when the previous round is
+missing, failed, or measured different shapes (e.g. every pre-r6
+64 px artifact).
+
+Same honesty contract as bench.py: the platform is stamped, so a CPU
+fallback can never masquerade as a TPU number.
 """
 
+import hashlib
 import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_artifacts import load_block, round_number, \
+    round_paths  # noqa: E402
+
+PERF_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "perf")
+
+# The constant measured configuration (every round, every platform).
+MODEL = "resnet50_v1.5"
+IMAGE_SIZE = 224
+CLASSES = 1000
+PER_CHIP_BATCH = 2      # fixed: the tracked program's shape
+# comparability requires identical compiled shapes; only the sample
+# count differs by platform (CPU steps are expensive)
+STEPS = {"cpu": 4, "default": 30}
+WARMUP = {"cpu": 1, "default": 5}
+
+
+def _tool_hash() -> str:
+    try:
+        with open(os.path.abspath(__file__), "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return "unknown"
+
+
+def prev_rounds():
+    """[(result dict, path), ...] newest first (unreadable rounds
+    skipped) — vs_prev scans back to the latest COMPARABLE round, so
+    one failed/incompatible round can't break the tracking number."""
+    out = []
+    for p in reversed(round_paths(PERF_DIR, "BENCH_RESNET_")):
+        doc = load_block(p)
+        if doc is not None:
+            out.append((doc, p))
+    return out
+
+
+def next_round_path() -> str:
+    paths = round_paths(PERF_DIR, "BENCH_RESNET_")
+    nn = (round_number(paths[-1]) + 1) if paths else 1
+    return os.path.join(PERF_DIR, "BENCH_RESNET_r%02d.json" % nn)
+
+
+def vs_prev(result: dict, prev) -> tuple:
+    """(ratio or None, why) — the LM1B-style round-over-round tracking
+    number, computed only between shape-compatible measurements."""
+    if not isinstance(prev, dict):
+        return None, "no previous round artifact"
+    if not isinstance(prev.get("value"), (int, float)) \
+            or prev["value"] <= 0:
+        return None, "previous round failed or has no value"
+    for key in ("platform", "n_chips", "model", "image_size",
+                "classes", "per_chip_batch"):
+        if result.get(key) != prev.get(key):
+            return None, (f"{key} differs ({prev.get(key)!r} -> "
+                          f"{result.get(key)!r}); not comparable")
+    return round(result["value"] / prev["value"], 4), "comparable"
 
 
 def main():
@@ -29,21 +101,21 @@ def main():
 
     n_chips = jax.device_count()
     platform = jax.devices()[0].platform
-    on_cpu = platform == "cpu"
-    if on_cpu:
-        name, size, bs, steps, warmup = "resnet50_v1.5", 64, 2 * n_chips, 6, 2
-        classes = 100
-    else:
-        name, size, bs, steps, warmup = ("resnet50_v1.5", 224,
-                                         64 * n_chips, 30, 5)
-        classes = 1000
+    key = "cpu" if platform == "cpu" else "default"
+    steps = int(os.environ.get("PARALLAX_RESNET_STEPS",
+                               STEPS[key]))
+    warmup = int(os.environ.get("PARALLAX_RESNET_WARMUP",
+                                WARMUP[key]))
+    bs = PER_CHIP_BATCH * n_chips
 
-    model = cnn.build_model(name, num_classes=classes, image_size=size)
+    model = cnn.build_model(MODEL, num_classes=CLASSES,
+                            image_size=IMAGE_SIZE)
     sess, *_ = parallax.parallel_run(
         model, parallax_config=parallax.Config(run_option="AR",
                                                search_partitions=False))
     rng = np.random.default_rng(0)
-    batches = [cnn.make_batch(rng, bs, size, classes) for _ in range(2)]
+    batches = [cnn.make_batch(rng, bs, IMAGE_SIZE, CLASSES)
+               for _ in range(2)]
     for i in range(warmup):
         sess.run("loss", feed_dict=batches[i % 2])
     jax.block_until_ready(sess.state.params)
@@ -52,28 +124,56 @@ def main():
         sess.run([], feed_dict=batches[i % 2])
     jax.block_until_ready(sess.state.params)
     dt = time.perf_counter() - t0
+    goodput = sess.timeline.goodput()
     sess.close()
 
     result = {
         "metric": "resnet50_images_per_sec_per_chip",
-        "value": round(bs * steps / dt / n_chips, 2),
+        "value": round(bs * steps / dt / n_chips, 3),
         "unit": "images/sec/chip",
-        "steps_per_sec": round(steps / dt, 3),
+        "steps_per_sec": round(steps / dt, 4),
         "platform": platform,
         "n_chips": n_chips,
-        "model": name,
-        "image_size": size,
+        "model": MODEL,
+        "image_size": IMAGE_SIZE,
+        "classes": CLASSES,
+        "per_chip_batch": PER_CHIP_BATCH,
         "global_batch": bs,
-        "note": ("CPU smoke shapes (64px, tiny batch) — structure "
-                 "only, not a throughput claim" if on_cpu else
-                 "realistic per-chip batch 64 at 224px"),
+        # step-time attribution over the measured window (obs/timeline)
+        "goodput": goodput,
+        "harness": {
+            "tool_sha256": _tool_hash(),
+            "steps_measured": steps,
+            "warmup_steps": warmup,
+        },
+        "note": ("constant tracked config: 224px v1.5, 1000 classes, "
+                 f"{PER_CHIP_BATCH}/chip — comparable round-over-round "
+                 "within one platform/chip-count"),
     }
+    # scan back to the LATEST comparable round (a failed or
+    # shape-incompatible round in between must not break tracking)
+    ratio, why, prev_path = None, "no previous round artifact", None
+    for i, (prev, path) in enumerate(prev_rounds()):
+        r, w = vs_prev(result, prev)
+        if i == 0:
+            # nothing comparable at all -> report the LATEST round's
+            # reason, not the oldest scanned
+            why, prev_path = w, path
+        if r is not None:
+            ratio, why, prev_path = r, w, path
+            break
+    result["vs_prev"] = ratio
+    result["vs_prev_basis"] = {
+        "path": os.path.basename(prev_path) if prev_path else None,
+        "why": why,
+    }
+
     line = json.dumps(result)
     print(line)
-    out = os.path.join(os.path.dirname(__file__), "..", "perf",
-                       "BENCH_RESNET_r05.json")
+    out = next_round_path()
     with open(out, "w") as f:
         f.write(line + "\n")
+    print(f"# wrote {os.path.relpath(out)}", file=sys.stderr)
 
 
 if __name__ == "__main__":

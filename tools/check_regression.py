@@ -1,0 +1,227 @@
+"""Bench regression gate: fail on unexplained headline moves.
+
+VERDICT r5: the r4→r5 headline moved −23% and "no artifact explains
+it"; nothing would catch a 2× regression round-over-round. This tool
+turns that into a gated check: compare the current ``BENCH_r*.json``
+against the previous round's block and FAIL (exit 1) when the headline
+moved more than ``--max-drop`` between *harness-compatible* rounds
+without an in-artifact explanation.
+
+Comparability — a delta is only attributable when the two rounds
+measured the same thing the same way:
+
+  * same ``metric`` and ``platform`` and ``n_chips`` (a CPU-fallback
+    round can never gate a TPU round, and vice versa);
+  * same ``bench_version``; a version bump is a declared methodology
+    change and is judged EXPLAINED iff the current artifact carries the
+    ``ab_vs_prev_harness`` A/B block (bench.py records it
+    automatically on a bump) — the block shows what part of the move
+    the methodology accounts for;
+  * when both rounds carry ``harness.bench_sha256``, the hashes must
+    match (same version but an edited harness file is an undeclared
+    methodology change → not comparable, reported as such).
+
+Explanations accepted for an over-threshold move between comparable
+rounds: a ``regression_note`` string in the current artifact (a human
+wrote down why). Anything else over the threshold fails.
+
+Artifacts are accepted in both layouts: the driver wrapper
+(``{"parsed": {...}}``, what lands in the repo root) and the raw
+bench.py JSON line. Failed rounds (``value`` 0 / ``error`` set) never
+gate — there is nothing to compare.
+
+Usage::
+
+    python tools/check_regression.py                  # two latest BENCH_r*.json
+    python tools/check_regression.py CUR.json PREV.json --max-drop 0.15
+
+Exit 0: ok / explained / not comparable (reported); exit 1: unexplained
+regression; exit 2: nothing usable to compare (missing/unreadable/
+failed artifacts) — the gate fails CLOSED rather than showing green
+over data it never measured.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_artifacts import latest_rounds, load_block  # noqa: E402
+
+DEFAULT_MAX_DROP = 0.15   # fail on >15% unexplained headline drop
+DEFAULT_MAX_RISE = 0.50   # >50% unexplained rise is *flagged* (exit 0)
+
+# shared round-file/driver-wrapper conventions (tools/bench_artifacts)
+load_bench = load_block
+discover_rounds = latest_rounds
+
+
+def _usable(block: Optional[dict]) -> bool:
+    return (isinstance(block, dict)
+            and isinstance(block.get("value"), (int, float))
+            and block["value"] > 0
+            and not block.get("error"))
+
+
+def compare(current: Optional[dict], previous: Optional[dict],
+            max_drop: float = DEFAULT_MAX_DROP,
+            max_rise: float = DEFAULT_MAX_RISE) -> dict:
+    """The gate verdict. ``status``:
+
+    * ``ok``              — comparable, move within bounds
+    * ``explained``       — over-threshold but explained in-artifact
+    * ``regression``      — unexplained drop beyond ``max_drop`` (FAIL)
+    * ``suspicious_rise`` — unexplained rise beyond ``max_rise``
+      (flagged, passes: faster is not a failure, but an unexplained 2×
+      "win" usually means the harness broke)
+    * ``not_comparable``  — rounds measured different things (reported)
+    * ``no_data``         — fewer than two usable artifacts
+    """
+    out = {"max_drop": max_drop, "max_rise": max_rise}
+    if not _usable(current) or not _usable(previous):
+        out["status"] = "no_data"
+        out["why"] = ("current round unusable" if not _usable(current)
+                      else "previous round unusable (failed or missing)")
+        return out
+    out["current_value"] = current["value"]
+    out["previous_value"] = previous["value"]
+    ratio = current["value"] / previous["value"]
+    out["ratio"] = round(ratio, 4)
+    out["delta_pct"] = round((ratio - 1.0) * 100, 2)
+
+    for key in ("metric", "platform", "n_chips"):
+        if current.get(key) != previous.get(key):
+            out["status"] = "not_comparable"
+            out["why"] = (f"{key} differs: {current.get(key)!r} vs "
+                          f"{previous.get(key)!r}")
+            return out
+    if current.get("bench_version") != previous.get("bench_version"):
+        bump = (f"bench_version bumped "
+                f"{previous.get('bench_version')} -> "
+                f"{current.get('bench_version')}")
+        ab = current.get("ab_vs_prev_harness")
+        v_ab = (ab.get("value_under_prev_params")
+                if isinstance(ab, dict) else None)
+        if not isinstance(v_ab, (int, float)) or v_ab <= 0:
+            out["status"] = "not_comparable"
+            out["why"] = (f"{bump} with no usable ab_vs_prev_harness "
+                          "A/B block — the methodology move is "
+                          "unexplained in-artifact")
+            return out
+        # the A/B IS the apples-to-apples number: the current build
+        # measured under the previous round's harness params. The gate
+        # judges THAT ratio — a version bump must not amnesty a build
+        # regression the A/B itself exposes.
+        out["ab_vs_prev_harness"] = ab
+        ab_ratio = v_ab / previous["value"]
+        out["ab_ratio"] = round(ab_ratio, 4)
+        if ab_ratio < 1.0 - max_drop:
+            note = current.get("regression_note")
+            if note:
+                out["status"] = "explained"
+                out["why"] = (f"{bump}; A/B under prev params dropped "
+                              f"{round((ab_ratio - 1) * 100, 2)}% but "
+                              f"regression_note: {note}")
+            else:
+                out["status"] = "regression"
+                out["why"] = (
+                    f"{bump}, and the same-build A/B under the "
+                    f"PREVIOUS round's harness params still dropped "
+                    f"{round((1 - ab_ratio) * 100, 2)}% (> "
+                    f"{max_drop * 100:.0f}%) — the move is the "
+                    f"build's, not the methodology's")
+        else:
+            out["status"] = "explained"
+            out["why"] = (f"{bump}; the same-build A/B under the "
+                          f"previous harness params moved only "
+                          f"{round((ab_ratio - 1) * 100, 2)}% — the "
+                          f"headline delta is methodology")
+        return out
+    cur_sha = (current.get("harness") or {}).get("bench_sha256")
+    prev_sha = (previous.get("harness") or {}).get("bench_sha256")
+    if cur_sha and prev_sha and cur_sha != prev_sha:
+        out["status"] = "not_comparable"
+        out["why"] = ("harness hash changed within bench_version "
+                      f"{current.get('bench_version')} ({prev_sha} -> "
+                      f"{cur_sha}): an undeclared methodology change")
+        return out
+    out["harness_verified"] = bool(cur_sha and prev_sha)
+
+    if ratio < 1.0 - max_drop:
+        note = current.get("regression_note")
+        if note:
+            out["status"] = "explained"
+            out["why"] = f"regression_note: {note}"
+        else:
+            out["status"] = "regression"
+            out["why"] = (f"headline dropped {out['delta_pct']}% "
+                          f"(> {max_drop * 100:.0f}%) between "
+                          "harness-compatible rounds with no "
+                          "explanation in-artifact")
+        return out
+    if ratio > 1.0 + max_rise:
+        out["status"] = "suspicious_rise"
+        out["why"] = (f"headline rose {out['delta_pct']}% — not a "
+                      "failure, but verify the harness still measures "
+                      "the same work")
+        return out
+    out["status"] = "ok"
+    out["why"] = f"move {out['delta_pct']}% within bounds"
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("current", nargs="?", default=None,
+                    help="current round artifact (default: latest "
+                         "BENCH_r*.json in the repo root)")
+    ap.add_argument("previous", nargs="?", default=None,
+                    help="previous round artifact (default: "
+                         "second-latest)")
+    ap.add_argument("--max-drop", type=float, default=DEFAULT_MAX_DROP,
+                    help="fail on an unexplained drop beyond this "
+                         "fraction (default 0.15)")
+    ap.add_argument("--max-rise", type=float, default=DEFAULT_MAX_RISE,
+                    help="flag an unexplained rise beyond this "
+                         "fraction (default 0.50)")
+    args = ap.parse_args(argv)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cur_path, prev_path = args.current, args.previous
+    if cur_path is None:
+        cur_path, _ = discover_rounds(root)
+    if prev_path is None and cur_path is not None:
+        # documented default: the latest round that is not the current
+        # artifact itself (works when `current` was given explicitly)
+        from bench_artifacts import round_paths
+        others = [p for p in round_paths(root)
+                  if os.path.abspath(p) != os.path.abspath(cur_path)]
+        prev_path = others[-1] if others else None
+    if cur_path is None:
+        print(json.dumps({"status": "no_data",
+                          "why": "no BENCH_r*.json artifacts found"}))
+        return 2
+    result = compare(load_bench(cur_path),
+                     load_bench(prev_path) if prev_path else None,
+                     max_drop=args.max_drop, max_rise=args.max_rise)
+    result["current_path"] = cur_path
+    result["previous_path"] = prev_path
+    print(json.dumps(result, indent=2))
+    if result["status"] == "regression":
+        return 1
+    if result["status"] == "no_data":
+        # fail CLOSED on unreadable/missing artifacts: a gate that
+        # measured nothing must not show green
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
