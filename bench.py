@@ -34,10 +34,18 @@ PROBE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # Methodology version stamped into the JSON (VERDICT r4 weak item 4):
 # cross-round vs_baseline comparisons are only valid within one version.
 #   v1 (r1-r3): baseline = full-softmax at the HEADLINE batch size.
-#   v2 (r4+):   baseline = full-softmax at the largest COMMON batch both
+#   v2 (r4-r5): baseline = full-softmax at the largest COMMON batch both
 #               paths fit (memory-limited), isolating the algorithmic win
 #               from batch-size utilization; CPU smoke vocab 16k.
-BENCH_VERSION = 2
+#   v3 (r6+):   headline methodology UNCHANGED from v2; the serve block
+#               gains the continuous-decode concurrency sweep
+#               (tokens/sec + TTFT per offered level, paged KV + chunked
+#               prefill + speculative decode) and the decode block gains
+#               the paged-vs-dense and speculative-vs-plain A/Bs
+#               (ISSUE 6). The version bump exists so the regression
+#               gate re-baselines the enlarged blocks; the same-build
+#               A/B under v2 params attributes any headline move.
+BENCH_VERSION = 3
 BASELINE_BASIS = ("sampled-softmax vs full-softmax LM1B at the same "
                   "memory-limited batch; headline measured separately at "
                   "the realistic batch")
@@ -467,14 +475,53 @@ def worker_main():
                 "step_ms_p50": round(step.get("p50", 0), 3)
                 if step else None,
             }
+            # Continuous-decode concurrency sweep (ISSUE 6): paged KV +
+            # chunked prefill + speculative decode at 1x..8x the r4/r5
+            # serve concurrency (max_batch was 8) — tokens/sec and TTFT
+            # per offered level, the 8x-64x-concurrency claim as one
+            # artifact. PARALLAX_BENCH_SWEEP=0 skips just the sweep.
+            if os.environ.get("PARALLAX_BENCH_SWEEP", "1") != "0":
+                levels = (8, 16, 32, 64)
+                # paged pool, one-dispatch prefill, no speculation:
+                # the sweep prices CONCURRENCY (the paged pool's win);
+                # chunked prefill trades refill throughput for bounded
+                # step stall and speculative economics depend on draft
+                # quality — both are priced separately (the SLO guard's
+                # decode phase and the decode block's A/Bs)
+                rows = loadgen.sweep_decode(
+                    levels=levels, speculative=False,
+                    prefill_chunk_layers=None, T=32)
+                by_level = {r["offered_concurrency"]: r for r in rows}
+                # the *_at_8x keys are regression-gated by name
+                # (tools/check_regression.py SECONDARY_GATES), so they
+                # bind to the literal 8x-of-r4 level (8 * 8 = 64) —
+                # absent from a future sweep, they stamp None and the
+                # gate SKIPS instead of silently comparing a different
+                # concurrency
+                at8 = by_level.get(8 * 8)
+                best = max((r["tokens_per_sec"] or 0) for r in rows)
+                serve_snap["continuous"] = {
+                    "sweep": rows,
+                    "prev_round_max_concurrency": 8,
+                    "max_offered_concurrency": max(levels),
+                    "concurrency_multiple": max(levels) // 8,
+                    "tokens_per_sec_best": best or None,
+                    "ttft_ms_p50_at_8x": ((at8.get("ttft_ms") or {})
+                                          .get("p50") if at8 else None),
+                    "tokens_per_sec_at_8x": (at8.get("tokens_per_sec")
+                                             if at8 else None),
+                    "recompiles": sum(r.get("recompiles", 0)
+                                      for r in rows),
+                }
         except Exception as e:
             print(f"# serve bench failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
 
-    # Decode block (VERDICT r5 satellite): the cached-vs-cacheless NMT
-    # decode ratios (tools/nmt_decode_timing.py) — the serve-side
-    # latency primitive — tracked per round instead of a one-off perf
-    # file. PARALLAX_BENCH_DECODE=0 skips it.
+    # Decode block (VERDICT r5 satellite + ISSUE 6): cached-vs-
+    # cacheless NMT decode ratios plus the paged-vs-dense and
+    # speculative-vs-plain A/Bs (tools/nmt_decode_timing.py) — every
+    # serve-side latency primitive tracked per round instead of a
+    # one-off perf file. PARALLAX_BENCH_DECODE=0 skips it.
     decode_snap = None
     if os.environ.get("PARALLAX_BENCH_DECODE", "1") != "0":
         try:
@@ -484,6 +531,9 @@ def worker_main():
             decode_snap = {
                 "rows": d["rows"],
                 "ratio_grows_with_T": d["ratio_grows_with_T"],
+                "paged_vs_dense": d.get("paged_vs_dense"),
+                "spec_vs_plain": d.get("spec_vs_plain"),
+                "spec_ceiling": d.get("spec_ceiling"),
             }
         except Exception as e:
             print(f"# decode bench failed: {type(e).__name__}: "

@@ -177,8 +177,12 @@ def _self_block(cfg, dt, p, x, cross_kv=None, *, self_causal=False,
                        p["ln2"]["b"].astype(dt))
 
 
-def _encode(cfg, params, src):
-    """Run the encoder stack; returns (enc_out [B,Ts,D] bf16, src_valid)."""
+def _encode_embed(cfg, params, src):
+    """Encoder front half: embedding + positional add; returns
+    (x [B,Ts,D], src_valid). Split out so serve-side chunked prefill
+    (serve/adapters.py) can run the encoder in fixed-size layer pieces
+    interleaved with decode steps — same ops in the same order as
+    :func:`_encode`."""
     dt = cfg.compute_dtype
     Ts = src.shape[1]
     pos = params["pos"].astype(dt)
@@ -186,9 +190,22 @@ def _encode(cfg, params, src):
     # would silently promote the whole bf16 stack to fp32
     x = (emb_ops.embedding_lookup(params["emb"], src).astype(dt)
          * jnp.asarray(np.sqrt(cfg.model_dim), dt) + pos[None, :Ts])
-    src_valid = (src > PAD_ID)
-    for p in params["enc"]:
+    return x, (src > PAD_ID)
+
+
+def _encode_layers(cfg, params, x, src_valid, lo, hi):
+    """Encoder layers ``[lo, hi)`` applied to the running hidden state
+    (``lo``/``hi`` are Python ints — layer selection is static)."""
+    dt = cfg.compute_dtype
+    for p in params["enc"][lo:hi]:
         x = _self_block(cfg, dt, p, x, self_kv_mask=src_valid)
+    return x
+
+
+def _encode(cfg, params, src):
+    """Run the encoder stack; returns (enc_out [B,Ts,D] bf16, src_valid)."""
+    x, src_valid = _encode_embed(cfg, params, src)
+    x = _encode_layers(cfg, params, x, src_valid, 0, len(params["enc"]))
     return x, src_valid
 
 
@@ -329,6 +346,131 @@ def _decode_step_cached_multi(cfg, params, tok, t, kc, vc, ck, cv,
         x = _layer_norm(x + y2,
                         p["ln2"]["s"].astype(dt), p["ln2"]["b"].astype(dt))
     logits = x[:, 0].astype(jnp.float32) @ params["out_proj"]
+    return emb_ops.mask_padded_logits(logits, cfg.vocab_size), kc, vc
+
+
+# ----- paged KV + multi-token (verify) decoding ---------------------------
+# The serve-side paged layout (serve/paging.py): self-attention K/V
+# lives in ONE pool [L, pool_pages, page_size, D] shared by every slot;
+# a slot's pages are named by a host-managed page table row [P] (P =
+# ceil(max_len / page_size)), entries beyond the slot's allocation hold
+# the OOB sentinel ``pool_pages``. The step GATHERS each slot's pages
+# into a contiguous [P * page_size, D] view for attention and SCATTERS
+# new K/V through the page table. Correctness rides on two properties:
+#
+#   * reads: jnp.take clips the sentinel to a live page, but every
+#     gathered position beyond a slot's frontier ``t`` is masked out of
+#     attention (pos <= t per query), so foreign/stale pages are never
+#     visible;
+#   * writes: a position whose page-table entry is the sentinel (or
+#     whose page index falls beyond the table) scatters out of bounds
+#     with mode="drop" — a slot can never corrupt another slot's pages,
+#     and dropped positions are exactly those a retiring slot never
+#     reads back.
+
+
+def _init_paged_self_cache(cfg, pool_pages: int, page_size: int):
+    L, D = cfg.num_layers, cfg.model_dim
+    z = jnp.zeros((L, pool_pages, page_size, D), cfg.compute_dtype)
+    return z, z
+
+
+def _decode_tokens_cached(cfg, params, tok, t, kc, vc, ck, cv, src_valid,
+                          pages=None, page_size=None):
+    """``G`` cached decoder steps in ONE dispatch: ``tok`` [S, G] holds
+    each slot's tokens for positions ``t[s] .. t[s]+G-1``; returns
+    (logits [S, G, V], kc, vc). With ``G == 1`` this is the
+    ``_decode_step_cached_multi`` math; with ``G > 1`` it is the
+    speculative-decode VERIFY step — query ``g`` attends to cache
+    positions ``<= t+g``, so output ``g`` is bit-identical to the
+    single-token step fed the same prefix (the exact-under-greedy
+    guarantee rides on this; tested in tests/test_paged_kv.py).
+
+    ``pages`` [S, P] selects the paged self-KV layout: ``kc``/``vc``
+    are the [L, pool_pages, page_size, D] pool and positions map
+    through the page table; ``pages=None`` keeps the dense
+    [L, S, T, D] per-slot layout.
+
+    Bit-identity note: the K/V/MLP/output projections are batched over
+    ``G`` (row-wise bit-identical to the G=1 shapes on this backend)
+    but the two attention einsums are UNROLLED over the G queries at
+    Tq=1 — a wider score matmul tiles its reduction differently and
+    drifts ~1e-7 off the single-step logits, which is exactly the
+    drift the exact-greedy guarantee cannot afford. G is small (the
+    speculation depth), so the unroll costs G tiny einsums while the
+    dominant [D,V] output projection stays batched."""
+    dt = cfg.compute_dtype
+    D = cfg.model_dim
+    S, G = tok.shape
+    paged = pages is not None
+    if paged:
+        pool, ps = kc.shape[1], int(page_size)
+        P = pages.shape[1]
+        Tbuf = P * ps
+        safe_pages = jnp.clip(pages, 0, pool - 1)
+    else:
+        Tbuf = kc.shape[2]
+        rows = jnp.arange(S)
+    offs = jnp.arange(G)
+    pos = t[:, None] + offs[None, :]                         # [S, G]
+    # clip: a verify window near the buffer end legitimately overshoots
+    # max_len; those queries' outputs are discarded host-side (the slot
+    # retires at its cap) but must stay finite (default take mode fills
+    # NaN)
+    pos_emb = jnp.take(params["pos"].astype(dt), pos, axis=0,
+                       mode="clip")                          # [S,G,D]
+    x = (emb_ops.embedding_lookup(params["emb"], tok).astype(dt)
+         * jnp.asarray(np.sqrt(D), dt) + pos_emb)           # [S, G, D]
+    # per-(slot, query) causal masks over the gathered/dense buffer,
+    # one [S,1,1,Tbuf] mask per unrolled query (the single-step shape)
+    q_masks = [(jnp.arange(Tbuf)[None, :]
+                <= pos[:, g][:, None])[:, None, None, :]
+               for g in range(G)]
+    cross_mask = src_valid[:, None, None, :]
+    if paged:
+        # write coordinates, shared by every layer: position p lands in
+        # page pages[s, p // ps] at offset p % ps; entries beyond the
+        # table (or holding the sentinel) become OOB and DROP
+        page_slot = pos // ps
+        pg = jnp.take_along_axis(pages, jnp.clip(page_slot, 0, P - 1),
+                                 axis=1)
+        pg = jnp.where((page_slot < P) & (pg < pool), pg, pool)
+        off = pos % ps
+
+    def _unrolled_attn(q, k_all, v_all, masks):
+        outs = [_attention(q[:, g:g + 1], k_all, v_all, masks[g],
+                           cfg.num_heads) for g in range(G)]
+        return outs[0] if G == 1 else jnp.concatenate(outs, axis=1)
+
+    for i, p in enumerate(params["dec"]):
+        a = p["attn"]
+        q = x @ a["wq"].astype(dt)
+        k_t = x @ a["wk"].astype(dt)
+        v_t = x @ a["wv"].astype(dt)
+        if paged:
+            kc = kc.at[i, pg, off].set(k_t, mode="drop")
+            vc = vc.at[i, pg, off].set(v_t, mode="drop")
+            k_all = jnp.take(kc[i], safe_pages,
+                             axis=0).reshape(S, Tbuf, D)
+            v_all = jnp.take(vc[i], safe_pages,
+                             axis=0).reshape(S, Tbuf, D)
+        else:
+            kc = kc.at[i, rows[:, None], pos].set(k_t, mode="drop")
+            vc = vc.at[i, rows[:, None], pos].set(v_t, mode="drop")
+            k_all, v_all = kc[i], vc[i]
+        y = _unrolled_attn(q, k_all, v_all, q_masks)
+        x = _layer_norm(x + y @ a["wo"].astype(dt),
+                        p["ln1"]["s"].astype(dt), p["ln1"]["b"].astype(dt))
+        c = p["cross"]
+        qc = x @ c["wq"].astype(dt)
+        yc = _unrolled_attn(qc, ck[i], cv[i], [cross_mask] * G)
+        x = _layer_norm(x + yc @ c["wo"].astype(dt),
+                        p["ln3"]["s"].astype(dt), p["ln3"]["b"].astype(dt))
+        m = p["mlp"]
+        y2 = jax.nn.relu(x @ m["w1"].astype(dt)) @ m["w2"].astype(dt)
+        x = _layer_norm(x + y2,
+                        p["ln2"]["s"].astype(dt), p["ln2"]["b"].astype(dt))
+    logits = x.astype(jnp.float32) @ params["out_proj"]
     return emb_ops.mask_padded_logits(logits, cfg.vocab_size), kc, vc
 
 

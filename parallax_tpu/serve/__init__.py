@@ -27,18 +27,22 @@ step wall-time) in tier-1.
 """
 
 from parallax_tpu.common.config import ServeConfig
-from parallax_tpu.serve.adapters import NMTDecodeProgram
+from parallax_tpu.serve.adapters import (NMTDecodeProgram,
+                                         layer_skip_draft)
 from parallax_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
                                         Request, RequestQueue,
                                         ServeClosed, ServeError,
                                         ServeOverloaded)
 from parallax_tpu.serve.continuous import (ContinuousScheduler,
                                            DecodeProgram)
+from parallax_tpu.serve.paging import (PageAllocator, PagePoolExhausted,
+                                       pages_for)
 from parallax_tpu.serve.session import ServeSession
 
 __all__ = [
     "ServeSession", "ServeConfig", "Request", "RequestQueue",
     "MicroBatcher", "ContinuousScheduler", "DecodeProgram",
-    "NMTDecodeProgram", "ServeError", "ServeOverloaded",
+    "NMTDecodeProgram", "layer_skip_draft", "PageAllocator",
+    "PagePoolExhausted", "pages_for", "ServeError", "ServeOverloaded",
     "DeadlineExceeded", "ServeClosed",
 ]

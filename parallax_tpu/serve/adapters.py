@@ -5,11 +5,36 @@ adapter binds it to one model family's prefill/step math. The NMT
 adapter below reuses models/nmt.py's encoder, cross-attention K/V
 precompute and the per-slot-position cached decoder step — the exact
 KV-cached math ``greedy_decode`` runs, restructured from "one
-fori_loop per batch" into "one step per scheduler iteration".
+fori_loop per batch" into "one step per scheduler iteration" — plus
+the three high-concurrency extensions of ISSUE 6:
+
+* **paged self-KV** (``page_size``/``pool_pages``): the per-slot
+  ``[L, S, T, D]`` self caches become ONE ``[L, pool_pages,
+  page_size, D]`` pool addressed through host-managed page tables
+  (serve/paging.py), so slot count is a scheduling knob and memory is
+  bounded by in-flight tokens;
+* **chunked prefill** (``prefill_chunk_layers``): the encoder runs in
+  fixed-size layer pieces the scheduler interleaves with decode
+  steps — a long newcomer costs at most one chunk per iteration, never
+  a whole prefill;
+* **speculative decoding** (``spec_tokens`` + ``draft_cfg`` /
+  ``draft_params``): a small draft NMT proposes k tokens per
+  iteration, the target model verifies all k (+1 bonus) in ONE
+  dispatch, the scheduler accepts the longest agreeing prefix — exact
+  under greedy because the verify step is bit-identical to k+1 single
+  steps (models/nmt.py ``_decode_tokens_cached``).
+
+Every device path is one jitted callable with one fixed signature
+(draft step, verify step, each prefill chunk, insert, plain step), so
+the enlarged signature set is still CLOSED and AOT-warmed at scheduler
+construction — ``tools/check_serve_slo.py`` holds serve-time compiles
+at zero across all of it.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import functools
 from typing import Any, Dict, Optional
 
 import jax
@@ -19,6 +44,7 @@ import numpy as np
 from parallax_tpu.compile import bucketing
 from parallax_tpu.models import nmt
 from parallax_tpu.serve.continuous import DecodeProgram
+from parallax_tpu.serve.paging import pages_for
 
 
 class NMTDecodeProgram(DecodeProgram):
@@ -30,16 +56,31 @@ class NMTDecodeProgram(DecodeProgram):
     bit-identical to the unpadded encode). ``max_len`` fixes the
     decode buffer ``T`` (the per-request token cap).
 
-    State layout per slot set ``S``: cross K/V ``[L, S, Ts, D]``
+    Dense state layout per slot set ``S``: cross K/V ``[L, S, Ts, D]``
     written at prefill, self K/V caches ``[L, S, T, D]`` written one
     position per step, ``src_valid [S, Ts]``. A freed slot's stale
     cache needs no zeroing — positions beyond a slot's own ``t`` are
     masked, and every position ``<= t`` is freshly written after a
     refill.
+
+    Paged layout (``page_size`` set): the self caches become the
+    ``[L, pool_pages, page_size, D]`` pool; the scheduler passes each
+    step a ``[S, pages_per_seq]`` int32 page table whose unallocated
+    entries hold the OOB sentinel ``pool_pages`` (writes drop, reads
+    clip-then-mask — see serve/paging.py). ``page_size`` must divide
+    ``max_len`` so the gathered attention buffer has exactly the dense
+    buffer's width (the bit-identity contract rides on matching
+    shapes).
     """
 
     def __init__(self, cfg: nmt.NMTConfig, max_src_len: int,
-                 max_len: Optional[int] = None):
+                 max_len: Optional[int] = None, *,
+                 page_size: Optional[int] = None,
+                 pool_pages: Optional[int] = None,
+                 prefill_chunk_layers: Optional[int] = None,
+                 spec_tokens: int = 0,
+                 draft_cfg: Optional[nmt.NMTConfig] = None,
+                 draft_params: Any = None):
         self.cfg = cfg
         self.Ts = int(max_src_len)
         self.max_len = int(max_len or cfg.max_len)
@@ -54,9 +95,91 @@ class NMTDecodeProgram(DecodeProgram):
         self.bos_id = nmt.BOS_ID
         self.eos_id = nmt.EOS_ID
         self.pad_id = nmt.PAD_ID
+
+        # -- paged KV pool -------------------------------------------------
+        self.paged = page_size is not None
+        if self.paged:
+            if pool_pages is None:
+                raise ValueError(
+                    "page_size given without pool_pages; the pool size "
+                    "is the memory bound and must be declared")
+            self.page_size = int(page_size)
+            self.pool_pages = int(pool_pages)
+            if self.page_size < 1 or self.pool_pages < 1:
+                raise ValueError(
+                    f"page_size={page_size} / pool_pages={pool_pages} "
+                    f"must be >= 1")
+            if self.max_len % self.page_size != 0:
+                raise ValueError(
+                    f"page_size={page_size} must divide max_len="
+                    f"{self.max_len}: the gathered attention buffer "
+                    f"must match the dense buffer width exactly "
+                    f"(bit-identity contract)")
+            self.pages_per_seq = self.max_len // self.page_size
+            if self.pool_pages < self.pages_per_seq:
+                raise ValueError(
+                    f"pool_pages={pool_pages} cannot hold even one "
+                    f"max-length sequence ({self.pages_per_seq} pages)")
+        elif pool_pages is not None:
+            raise ValueError("pool_pages given without page_size")
+
+        # -- chunked prefill ----------------------------------------------
+        L = cfg.num_layers
+        if prefill_chunk_layers is not None:
+            c = int(prefill_chunk_layers)
+            if not 1 <= c <= L:
+                raise ValueError(
+                    f"prefill_chunk_layers={prefill_chunk_layers} "
+                    f"outside [1, num_layers={L}]")
+            self._layer_chunks = [(k * c, min((k + 1) * c, L))
+                                  for k in range(-(-L // c))]
+            # + the final cross-K/V (and draft-prefill) piece
+            self.num_prefill_chunks = len(self._layer_chunks) + 1
+        else:
+            self._layer_chunks = None
+            self.num_prefill_chunks = 1
+
+        # -- speculative decoding -----------------------------------------
+        self.spec_tokens = int(spec_tokens or 0)
+        if self.spec_tokens:
+            if self.spec_tokens < 1:
+                raise ValueError(
+                    f"spec_tokens={spec_tokens} must be >= 1")
+            if draft_cfg is None or draft_params is None:
+                raise ValueError(
+                    "spec_tokens set without draft_cfg/draft_params — "
+                    "speculative decoding needs the small draft model")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target "
+                    f"vocab {cfg.vocab_size}; proposals must share the "
+                    f"token id space")
+            if draft_cfg.max_len < self.max_len:
+                raise ValueError(
+                    f"draft max_len {draft_cfg.max_len} < decode "
+                    f"buffer {self.max_len}; the draft's positional "
+                    f"table must cover every decode position")
+            self.draft_cfg = draft_cfg
+            self.draft_params = draft_params
+        else:
+            self.draft_cfg = None
+            self.draft_params = None
+
+        # -- jitted device programs (one fixed signature each) ------------
         self._prefill_jit = jax.jit(self._prefill)
         self._insert_jit = jax.jit(self._insert)
         self._step_jit = jax.jit(self._step)
+        if self._layer_chunks is not None:
+            self._chunk_jits = [
+                jax.jit(functools.partial(self._prefill_embed_chunk,
+                                          hi=self._layer_chunks[0][1]))]
+            for lo, hi in self._layer_chunks[1:]:
+                self._chunk_jits.append(jax.jit(functools.partial(
+                    self._prefill_layers_chunk, lo=lo, hi=hi)))
+            self._chunk_jits.append(jax.jit(self._prefill_finish))
+        if self.spec_tokens:
+            self._draft_step_jit = jax.jit(self._draft_step)
+            self._verify_jit = jax.jit(self._verify)
 
     # -- feed contract -----------------------------------------------------
 
@@ -75,25 +198,90 @@ class NMTDecodeProgram(DecodeProgram):
                 f"{self.Ts}")
         return {"src": bucketing.pad_axis0(src, self.Ts, self.pad_id)}
 
+    def pages_needed(self, cap: int) -> int:
+        """Pages one request with token cap ``cap`` owns while in
+        flight (the scheduler allocates exactly this many at refill)."""
+        return pages_for(cap, self.page_size)
+
     # -- device programs (each jitted once; fixed shapes) ------------------
 
     def init_state(self, params, slots: int) -> Dict[str, jax.Array]:
         cfg = self.cfg
         L, D, dt = cfg.num_layers, cfg.model_dim, cfg.compute_dtype
         z_cross = jnp.zeros((L, slots, self.Ts, D), dt)
-        z_self = jnp.zeros((L, slots, self.max_len, D), dt)
-        return {"ck": z_cross, "cv": z_cross,
-                "kc": z_self, "vc": z_self,
-                "src_valid": jnp.zeros((slots, self.Ts), bool)}
+        state = {"ck": z_cross, "cv": z_cross,
+                 "src_valid": jnp.zeros((slots, self.Ts), bool)}
+        if self.paged:
+            kp, vp = nmt._init_paged_self_cache(cfg, self.pool_pages,
+                                                self.page_size)
+            state["kc"], state["vc"] = kp, vp
+        else:
+            z_self = jnp.zeros((L, slots, self.max_len, D), dt)
+            state["kc"], state["vc"] = z_self, z_self
+        if self.spec_tokens:
+            dcfg = self.draft_cfg
+            Ld, Dd = dcfg.num_layers, dcfg.model_dim
+            ddt = dcfg.compute_dtype
+            state["d_ck"] = jnp.zeros((Ld, slots, self.Ts, Dd), ddt)
+            state["d_cv"] = state["d_ck"]
+            # the draft's self cache stays dense per-slot: the draft is
+            # the SMALL model — its cache is what the pool exists to
+            # avoid paying for the big one
+            zd = jnp.zeros((Ld, slots, self.max_len, Dd), ddt)
+            state["d_kc"], state["d_vc"] = zd, zd
+        return state
 
     def prefill(self, params, feed):
+        """The whole per-request one-time work in one dispatch (the
+        unchunked path; chunked programs go through
+        :meth:`prefill_chunk`)."""
         return self._prefill_jit(params, feed)
 
     def _prefill(self, params, feed):
         src = feed["src"][None]                              # [1, Ts]
         enc_out, src_valid = nmt._encode(self.cfg, params, src)
         ck, cv = nmt._cross_kv(self.cfg, params, enc_out)    # [L,1,Ts,D]
-        return {"ck": ck, "cv": cv, "src_valid": src_valid}
+        rs = {"ck": ck, "cv": cv, "src_valid": src_valid}
+        if self.spec_tokens:
+            rs.update(self._draft_prefill(src))
+        return rs
+
+    def _draft_prefill(self, src):
+        d_enc, _ = nmt._encode(self.draft_cfg, self.draft_params, src)
+        d_ck, d_cv = nmt._cross_kv(self.draft_cfg, self.draft_params,
+                                   d_enc)
+        return {"d_ck": d_ck, "d_cv": d_cv}
+
+    # chunked prefill: the same encoder math split at layer boundaries,
+    # each piece one jitted signature the scheduler runs between decode
+    # steps. Identical ops in identical order — the chunk boundaries
+    # are jit boundaries, not math changes.
+
+    def prefill_chunk(self, params, carry, k: int):
+        """Advance one prefill by one piece: ``carry`` is the prepared
+        feed for ``k == 0`` and the previous chunk's output after;
+        chunk ``num_prefill_chunks - 1`` returns the request state
+        :meth:`insert` accepts."""
+        return self._chunk_jits[k](params, carry)
+
+    def _prefill_embed_chunk(self, params, feed, hi: int):
+        src = feed["src"][None]
+        x, src_valid = nmt._encode_embed(self.cfg, params, src)
+        x = nmt._encode_layers(self.cfg, params, x, src_valid, 0, hi)
+        return {"x": x, "src_valid": src_valid, "src": src}
+
+    def _prefill_layers_chunk(self, params, carry, lo: int, hi: int):
+        out = dict(carry)
+        out["x"] = nmt._encode_layers(self.cfg, params, carry["x"],
+                                      carry["src_valid"], lo, hi)
+        return out
+
+    def _prefill_finish(self, params, carry):
+        ck, cv = nmt._cross_kv(self.cfg, params, carry["x"])
+        rs = {"ck": ck, "cv": cv, "src_valid": carry["src_valid"]}
+        if self.spec_tokens:
+            rs.update(self._draft_prefill(carry["src"]))
+        return rs
 
     def insert(self, state, slot, request_state):
         return self._insert_jit(state, slot, request_state)
@@ -106,19 +294,114 @@ class NMTDecodeProgram(DecodeProgram):
             state["cv"], rs["cv"], (0, slot, 0, 0))
         out["src_valid"] = jax.lax.dynamic_update_slice(
             state["src_valid"], rs["src_valid"], (slot, 0))
+        if self.spec_tokens:
+            out["d_ck"] = jax.lax.dynamic_update_slice(
+                state["d_ck"], rs["d_ck"], (0, slot, 0, 0))
+            out["d_cv"] = jax.lax.dynamic_update_slice(
+                state["d_cv"], rs["d_cv"], (0, slot, 0, 0))
         return out
 
-    def step(self, params, state, tok, t):
-        return self._step_jit(params, state, tok, t)
+    # -- plain decode step -------------------------------------------------
 
-    def _step(self, params, state, tok, t):
-        logits, kc, vc = nmt._decode_step_cached_multi(
-            self.cfg, params, tok, t, state["kc"], state["vc"],
-            state["ck"], state["cv"], state["src_valid"])
+    def step(self, params, state, tok, t, pages=None):
+        return self._step_jit(params, state, tok, t, pages)
+
+    def _step(self, params, state, tok, t, pages):
+        if self.paged:
+            logits, kc, vc = nmt._decode_tokens_cached(
+                self.cfg, params, tok[:, None], t, state["kc"],
+                state["vc"], state["ck"], state["cv"],
+                state["src_valid"], pages=pages,
+                page_size=self.page_size)
+            logits = logits[:, 0]
+        else:
+            logits, kc, vc = nmt._decode_step_cached_multi(
+                self.cfg, params, tok, t, state["kc"], state["vc"],
+                state["ck"], state["cv"], state["src_valid"])
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         out = dict(state)
         out["kc"], out["vc"] = kc, vc
         return nxt, out
 
+    # -- speculative decode ------------------------------------------------
 
-__all__ = ["NMTDecodeProgram"]
+    def _draft_step(self, params, state, tok, t):
+        logits, d_kc, d_vc = nmt._decode_tokens_cached(
+            self.draft_cfg, self.draft_params, tok[:, None], t,
+            state["d_kc"], state["d_vc"], state["d_ck"], state["d_cv"],
+            state["src_valid"])
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        out = dict(state)
+        out["d_kc"], out["d_vc"] = d_kc, d_vc
+        return nxt, out
+
+    def _verify(self, params, state, toks, t, pages):
+        logits, kc, vc = nmt._decode_tokens_cached(
+            self.cfg, params, toks, t, state["kc"], state["vc"],
+            state["ck"], state["cv"], state["src_valid"],
+            pages=pages if self.paged else None,
+            page_size=self.page_size if self.paged else None)
+        y = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [S, G]
+        out = dict(state)
+        out["kc"], out["vc"] = kc, vc
+        return y, out
+
+    def spec_step(self, params, state, tok, t, prev_tok, pages=None):
+        """One speculative iteration: k sequential DRAFT steps propose
+        tokens, ONE target dispatch verifies all k (+1 bonus) — the
+        scheduler accepts the longest prefix where proposal j equals
+        the target's greedy choice for that position.
+
+        ``prev_tok`` is the sequence content at position ``t - 1``
+        (BOS at ``t == 0``): the first draft dispatch re-writes that
+        position before proposing. When the previous iteration
+        accepted everything INCLUDING the bonus token, the draft never
+        cached the bonus position — the catch-up fills that one-
+        position hole; in every other case it rewrites the values
+        already there bit-identically, so it is always safe (and keeps
+        the draft step at ONE compiled signature).
+
+        Returns ``(y [S, k+1], proposals [S, k], state)``: ``y[:, j]``
+        is the target's greedy token after input j of
+        ``[tok, p_0 .. p_{k-1}]``; bit-identical to k+1 single steps,
+        so the accepted emission IS the plain greedy sequence."""
+        k = self.spec_tokens
+        _, state = self._draft_step_jit(
+            self.draft_params, state, jnp.asarray(prev_tok),
+            np.maximum(np.asarray(t) - 1, 0).astype(np.int32))
+        cur = jnp.asarray(tok)
+        props = []
+        for j in range(k):
+            cur, state = self._draft_step_jit(
+                self.draft_params, state, cur, t + np.int32(j))
+            props.append(cur)
+        proposals = jnp.stack(props, axis=1)                # [S, k]
+        toks = jnp.concatenate([jnp.asarray(tok)[:, None],
+                                proposals[:, :k]], axis=1)  # [S, k+1]
+        y, state = self._verify_jit(params, state, toks, t, pages)
+        return y, proposals, state
+
+
+def layer_skip_draft(cfg: nmt.NMTConfig, params, layers: int = 1):
+    """The zero-training draft model for speculative decoding: the
+    target's first ``layers`` encoder/decoder blocks with the shared
+    embedding/positional/output tables (layer-skip / early-exit
+    drafting). Returns ``(draft_cfg, draft_params)`` for
+    ``NMTDecodeProgram(spec_tokens=..., draft_cfg=, draft_params=)`` —
+    cheap, correlated with the target, and never trusted (the verify
+    step guarantees exact greedy output regardless of draft quality;
+    ``serve.spec_accept_rate`` reports what it actually buys)."""
+    layers = int(layers)
+    if not 1 <= layers <= cfg.num_layers:
+        raise ValueError(
+            f"layer_skip_draft layers={layers} outside "
+            f"[1, num_layers={cfg.num_layers}]")
+    draft_cfg = dataclasses.replace(cfg, num_layers=layers)
+    draft_params = {"emb": params["emb"], "pos": params["pos"],
+                    "enc": params["enc"][:layers],
+                    "dec": params["dec"][:layers],
+                    "out_proj": params["out_proj"]}
+    return draft_cfg, draft_params
+
+
+__all__ = ["NMTDecodeProgram", "layer_skip_draft"]

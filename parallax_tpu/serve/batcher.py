@@ -170,6 +170,18 @@ class RequestQueue:
             self._set_depth_locked()
             self._cond.notify_all()
 
+    def requeue_front(self, req: Request) -> None:
+        """Put an ALREADY-ADMITTED request back at the queue head (the
+        continuous scheduler defers a refill when the KV page pool is
+        exhausted — the request keeps its FIFO position and its
+        deadline). Bypasses the admission bound (the request was
+        counted at ``put``) and works on a closed queue (drain must
+        still serve it)."""
+        with self._cond:
+            self._items.insert(0, req)
+            self._set_depth_locked()
+            self._cond.notify_all()
+
     def _shed_expired_locked(self, now: float) -> None:
         kept = []
         for r in self._items:

@@ -1,4 +1,5 @@
-"""Slot-based continuous decoding (Orca-style, PAPERS.md).
+"""Slot-based continuous decoding (Orca-style, PAPERS.md) over a
+paged KV pool, with chunked prefill and speculative decoding (ISSUE 6).
 
 Static batching decodes a batch until its SLOWEST sequence finishes:
 a 5-token reply waits for the 120-token one next to it, and the batch
@@ -12,22 +13,38 @@ decode step and treats membership as dynamic:
 * a slot whose sequence just emitted EOS (or hit its token budget, or
   blew its deadline) RETIRES immediately — its request completes now,
   not when the batch's slowest member finishes;
-* the freed slot REFILLS from the request queue on the next iteration
-  (a single-request prefill writes the newcomer's encoder state into
-  the slot) — the batch never flushes, occupancy stays high under
-  load.
+* the freed slot REFILLS from the request queue — the batch never
+  flushes, occupancy stays high under load.
+
+Three throughput layers ride on top of the PR 4 scheduler:
+
+* **paged KV** — a :class:`~parallax_tpu.serve.paging.PageAllocator`
+  owns a fixed pool of fixed-size pages; a refill allocates
+  ``ceil(cap / page_size)`` pages and a retire frees them, so slot
+  count becomes a pure scheduling knob (8-64x the dense layout's) and
+  admission is governed by pool memory. Exhaustion DEFERS the refill
+  (the request stays queued, ``serve.kv_refill_deferred`` counts it)
+  instead of failing it — pages free as sequences retire.
+* **chunked prefill** — with a chunked program
+  (``num_prefill_chunks > 1``) at most ONE prefill piece runs per
+  scheduler iteration, so a long newcomer costs every decoding slot a
+  bounded slice of latency per step instead of a whole prefill stall.
+* **speculative decoding** — with ``spec_tokens = k`` the iteration
+  becomes k small DRAFT steps + one target VERIFY dispatch; the
+  longest agreeing prefix (plus the target's correction/bonus token)
+  is emitted, 1..k+1 tokens per iteration. Exact under greedy: the
+  verify step is bit-identical to k+1 single steps, so acceptance
+  reproduces the plain greedy sequence token for token.
 
 Correctness rides on per-slot independence: every per-token op
 (projections, attention with per-slot position masks, layer norms,
 argmax) is row-wise, so a slot's tokens are bit-identical to decoding
 its request alone — tested against per-request standalone decode in
-tests/test_serve.py.
+tests/test_serve.py and tests/test_paged_kv.py.
 
 The model plugs in as a :class:`DecodeProgram` (duck-typed; see
-serve/adapters.py for the NMT implementation): fixed-shape
-``init_state`` / ``prefill`` / ``insert`` / ``step`` callables the
-scheduler drives. All four are warmed at construction, so serving
-never meets an XLA compile.
+serve/adapters.py for the NMT implementation). Every device callable
+is warmed at construction, so serving never meets an XLA compile.
 """
 
 from __future__ import annotations
@@ -44,6 +61,7 @@ from parallax_tpu.common.lib import parallax_log
 from parallax_tpu.obs import trace
 from parallax_tpu.serve.batcher import (DeadlineExceeded, Request,
                                         RequestQueue)
+from parallax_tpu.serve.paging import PageAllocator, PagePoolExhausted
 
 
 class DecodeProgram:
@@ -53,17 +71,34 @@ class DecodeProgram:
     serving loop runs on a closed signature set.
 
     Attributes: ``max_len`` (decode buffer length — the per-request
-    token cap), ``bos_id`` / ``eos_id`` / ``pad_id``.
+    token cap), ``bos_id`` / ``eos_id`` / ``pad_id``. Optional
+    capability attributes (defaults in parentheses):
+
+    * ``paged`` (False): self-KV lives in a page pool; the program
+      additionally exposes ``page_size``, ``pool_pages``,
+      ``pages_per_seq`` and ``pages_needed(cap)``, and ``step`` /
+      ``spec_step`` take the ``[slots, pages_per_seq]`` int32 page
+      table (unallocated entries hold the sentinel ``pool_pages``).
+    * ``num_prefill_chunks`` (1): when > 1, prefill runs through
+      ``prefill_chunk(params, carry, k)`` — carry is the prepared feed
+      at k=0, the request state after the last chunk.
+    * ``spec_tokens`` (0): when k >= 1, the scheduler calls
+      ``spec_step(params, state, tok, t, prev_tok, pages) ->
+      (y [S, k+1], proposals [S, k], state)`` instead of ``step`` and
+      accepts the longest agreeing prefix (``prev_tok`` is the content
+      at position t-1 — the draft's catch-up input).
+
+    Core callables (shapes fixed per instance):
 
     * ``example_feed() -> dict`` — one request's feed at the padded
       shapes ``prefill`` accepts (used for warmup and planning).
     * ``prepare_feed(feed) -> dict`` — validate/pad one request's raw
       feed onto the fixed prefill shapes.
     * ``init_state(params, slots) -> state`` — fresh device state for
-      ``slots`` slots (KV caches, encoder memory, masks).
+      ``slots`` slots (KV caches/pool, encoder memory, masks).
     * ``prefill(params, feed) -> request_state`` — run the one-time
       per-request work (e.g. the encoder + cross-attention K/V) for a
-      single request.
+      single request in one dispatch.
     * ``insert(state, slot, request_state) -> state`` — write one
       prefilled request into slot ``slot`` (an int32 scalar; traced,
       so any slot index shares one compiled insert).
@@ -76,13 +111,28 @@ class DecodeProgram:
 
 
 class _Slot:
-    __slots__ = ("req", "tokens", "t", "cap")
+    __slots__ = ("req", "tokens", "t", "cap", "pages")
 
-    def __init__(self, req: Request, cap: int):
+    def __init__(self, req: Request, cap: int, pages: List[int]):
         self.req = req
         self.tokens: List[int] = []
         self.t = 0
         self.cap = cap
+        self.pages = pages
+
+
+class _Prefill:
+    """One in-flight chunked prefill: the reserved slot, its allocated
+    pages, the carry between chunks and the next chunk index."""
+
+    __slots__ = ("req", "slot", "pages", "carry", "k")
+
+    def __init__(self, req: Request, slot: int, pages: List[int]):
+        self.req = req
+        self.slot = slot
+        self.pages = pages
+        self.carry = req.feed
+        self.k = 0
 
 
 class ContinuousScheduler:
@@ -117,8 +167,37 @@ class ContinuousScheduler:
         self._tok_times: collections.deque = collections.deque(
             maxlen=self.TOKENS_PER_SEC_WINDOW)
         metrics.gauge("serve.tokens_per_sec").set_fn(self.tokens_per_sec)
+
+        # capability probes (duck-typed; PR 4 programs keep defaults)
+        self._paged = bool(getattr(program, "paged", False))
+        self._chunks = int(getattr(program, "num_prefill_chunks", 1))
+        self._spec = int(getattr(program, "spec_tokens", 0))
+        if self._paged:
+            self._alloc = PageAllocator(program.pool_pages)
+            self._P = int(program.pages_per_seq)
+            self._sentinel = int(program.pool_pages)
+            self._pages = np.full((self._S, self._P), self._sentinel,
+                                  np.int32)
+            self._pages_gauge = metrics.gauge("serve.kv_pages_in_use")
+            self._pages_gauge.set(0)
+            metrics.gauge("serve.kv_pool_pages").set(self._sentinel)
+            self._defer = metrics.counter("serve.kv_refill_deferred")
+        else:
+            self._pages = None
+        if self._chunks > 1:
+            self._chunk_ctr = metrics.counter("serve.prefill_chunks")
+        if self._spec:
+            self._spec_proposed = metrics.counter("serve.spec_proposed")
+            self._spec_accepted = metrics.counter("serve.spec_accepted")
+            metrics.gauge("serve.spec_accept_rate").set_fn(
+                self.spec_accept_rate)
+        self._pending: List[_Prefill] = []
+
         self._slots: List[Optional[_Slot]] = [None] * self._S
         self._tok = np.full((self._S,), program.pad_id, np.int32)
+        # content at position t-1 per slot (the speculative catch-up
+        # input; BOS right after a refill, where t == 0)
+        self._prev = np.full((self._S,), program.pad_id, np.int32)
         self._t = np.zeros((self._S,), np.int32)
         self._stop = threading.Event()
         self._kick = threading.Event()
@@ -131,25 +210,53 @@ class ContinuousScheduler:
     # -- warmup ------------------------------------------------------------
 
     def _warm(self) -> None:
-        """Execute prefill / insert / step once on dummy inputs so
-        their single signatures are compiled before serving (the state
-        this writes is discarded — a fresh one is built after)."""
+        """Execute every device callable the serving loop can dispatch
+        once on dummy inputs — prefill (all chunks), insert, and the
+        plain or speculative step — so the COMPLETE signature set is
+        compiled before serving (the state this writes is discarded —
+        a fresh one is built after)."""
         prog, params = self._program, self._params
         t0 = time.perf_counter()
         with trace.span("serve.warmup_compile", mode="decode"):
             state = prog.init_state(params, self._S)
-            rs = prog.prefill(params,
-                              prog.prepare_feed(prog.example_feed()))
+            feed = prog.prepare_feed(prog.example_feed())
+            if self._chunks > 1:
+                carry = feed
+                for k in range(self._chunks):
+                    carry = prog.prefill_chunk(params, carry, k)
+                rs = carry
+            else:
+                rs = prog.prefill(params, feed)
             state = prog.insert(state, np.int32(0), rs)
             tok = np.full((self._S,), prog.bos_id, np.int32)
-            nxt, state = prog.step(params, state, tok,
-                                   np.zeros((self._S,), np.int32))
-            jax.block_until_ready(nxt)
+            tz = np.zeros((self._S,), np.int32)
+            pages = self._pages.copy() if self._paged else None
+            if self._spec:
+                y, _, state = prog.spec_step(params, state, tok, tz,
+                                             tok, pages)
+                jax.block_until_ready(y)
+            else:
+                if self._paged:
+                    nxt, state = prog.step(params, state, tok, tz,
+                                           pages)
+                else:
+                    nxt, state = prog.step(params, state, tok, tz)
+                jax.block_until_ready(nxt)
+            # one more insert against the POST-step state: step outputs
+            # are committed device arrays whose jit signature differs
+            # from the fresh init_state leaves the first insert saw —
+            # without this, the first live retire-and-refill pays one
+            # serve-time compile
+            state = prog.insert(state, np.int32(0), rs)
+            jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
         dt = time.perf_counter() - t0
         self.metrics.histogram("serve.compile_seconds").record(dt)
         parallax_log.info(
-            "serve decode warmup: prefill/insert/step compiled in "
-            "%.2fs (%d slots)", dt, self._S)
+            "serve decode warmup: prefill(%d chunk(s))/insert/%s "
+            "compiled in %.2fs (%d slots%s)",
+            self._chunks, "spec_step" if self._spec else "step", dt,
+            self._S,
+            f", {self._sentinel}-page pool" if self._paged else "")
 
     # -- admission hooks (called by ServeSession) --------------------------
 
@@ -175,33 +282,113 @@ class ContinuousScheduler:
         n = sum(c for _, c in window[1:])
         return n / dt if dt > 0 else None
 
-    # -- the scheduling loop ----------------------------------------------
+    def spec_accept_rate(self) -> Optional[float]:
+        if not self._spec:
+            return None
+        prop = self._spec_proposed.value
+        return (self._spec_accepted.value / prop) if prop else None
 
-    def _active(self) -> int:
-        return sum(1 for s in self._slots if s is not None)
+    # -- paging ------------------------------------------------------------
+
+    def _alloc_pages(self, req: Request) -> Optional[List[int]]:
+        """Pages for one refill, or None to DEFER (pool exhausted —
+        retiring sequences will free pages; the request stays queued)."""
+        if not self._paged:
+            return []
+        n = self._program.pages_needed(req.max_new_tokens)
+        try:
+            ids = self._alloc.alloc(n)
+        except PagePoolExhausted:
+            self._defer.inc()
+            return None
+        self._pages_gauge.set(self._alloc.in_use)
+        return ids
+
+    def _release_pages(self, pages: List[int]) -> None:
+        if self._paged and pages:
+            self._alloc.free(pages)
+            self._pages_gauge.set(self._alloc.in_use)
+
+    def _clear_slot(self, j: int) -> None:
+        self._tok[j] = self._program.pad_id
+        self._prev[j] = self._program.pad_id
+        self._t[j] = 0
+        if self._paged:
+            self._pages[j, :] = self._sentinel
+
+    # -- refill / prefill --------------------------------------------------
+
+    def _activate(self, j: int, req: Request, pages: List[int],
+                  rs) -> None:
+        self._state = self._program.insert(self._state, np.int32(j), rs)
+        self._slots[j] = _Slot(req, req.max_new_tokens, pages)
+        self._tok[j] = self._program.bos_id
+        self._prev[j] = self._program.bos_id
+        self._t[j] = 0
+        if self._paged:
+            self._pages[j, :] = self._sentinel
+            self._pages[j, :len(pages)] = pages
 
     def _refill(self) -> None:
-        """Fill free slots from the queue: one single-request prefill
-        each, inserted without touching the running slots."""
+        """Unchunked path: fill free slots from the queue, one whole
+        single-request prefill each, inserted without touching the
+        running slots."""
         for j in range(self._S):
             if self._slots[j] is not None:
                 continue
             req = self._queue.pop(timeout=0.0)
             if req is None:
                 return
+            pages = self._alloc_pages(req)
+            if pages is None:
+                self._queue.requeue_front(req)
+                return
             with trace.span("serve.prefill", slot=j, id=req.id):
                 rs = self._program.prefill(self._params, req.feed)
-                self._state = self._program.insert(
-                    self._state, np.int32(j), rs)
-            self._slots[j] = _Slot(req, req.max_new_tokens)
-            self._tok[j] = self._program.bos_id
-            self._t[j] = 0
+                self._activate(j, req, pages, rs)
+
+    def _free_slot(self) -> Optional[int]:
+        reserved = {pp.slot for pp in self._pending}
+        for j in range(self._S):
+            if self._slots[j] is None and j not in reserved:
+                return j
+        return None
+
+    def _advance_prefill(self) -> None:
+        """Chunked path: run at most ONE prefill piece this iteration —
+        start a new prefill when none is pending (slot + pages
+        permitting), else advance the oldest by one chunk; the last
+        chunk's output is inserted into the reserved slot."""
+        if not self._pending:
+            j = self._free_slot()
+            if j is None:
+                return
+            req = self._queue.pop(timeout=0.0)
+            if req is None:
+                return
+            pages = self._alloc_pages(req)
+            if pages is None:
+                self._queue.requeue_front(req)
+                return
+            self._pending.append(_Prefill(req, j, pages))
+        pp = self._pending[0]
+        with trace.span("serve.prefill_chunk", slot=pp.slot,
+                        id=pp.req.id, k=pp.k):
+            pp.carry = self._program.prefill_chunk(self._params,
+                                                   pp.carry, pp.k)
+        pp.k += 1
+        self._chunk_ctr.inc()
+        if pp.k == self._chunks:
+            self._pending.pop(0)
+            self._activate(pp.slot, pp.req, pp.pages, pp.carry)
+
+    # -- retire / expire / fail --------------------------------------------
 
     def _retire(self, j: int, now: float) -> None:
         slot = self._slots[j]
         self._slots[j] = None
-        self._tok[j] = self._program.pad_id
-        self._t[j] = 0
+        self._release_pages(slot.pages)
+        self._clear_slot(j)
         req = slot.req
         req._complete(np.asarray(slot.tokens, np.int32))
         self._completed.inc()
@@ -216,13 +403,22 @@ class ContinuousScheduler:
                 continue
             if now > slot.req.deadline:
                 self._slots[j] = None
-                self._tok[j] = self._program.pad_id
-                self._t[j] = 0
+                self._release_pages(slot.pages)
+                self._clear_slot(j)
                 self._timeouts.inc()
                 n_expired += 1
                 slot.req._fail(DeadlineExceeded(
                     f"request {slot.req.id} deadline expired mid-"
                     f"decode after {len(slot.tokens)} token(s)"))
+        for pp in list(self._pending):
+            if pp.req.deadline is not None and now > pp.req.deadline:
+                self._pending.remove(pp)
+                self._release_pages(pp.pages)
+                self._timeouts.inc()
+                n_expired += 1
+                pp.req._fail(DeadlineExceeded(
+                    f"request {pp.req.id} deadline expired mid-"
+                    f"prefill after {pp.k} chunk(s)"))
         if n_expired and self._on_deadline_breach is not None:
             try:
                 self._on_deadline_breach(n_expired, where="decode")
@@ -231,19 +427,106 @@ class ContinuousScheduler:
                 pass
 
     def _fail_active(self, exc) -> None:
-        """Fail every in-flight slot — called ONLY from the scheduler
-        thread (slot state is single-owner; a cross-thread mutation
-        here would race the decode loop)."""
+        """Fail every in-flight slot and pending prefill — called ONLY
+        from the scheduler thread (slot state is single-owner; a
+        cross-thread mutation here would race the decode loop)."""
         for j, slot in enumerate(self._slots):
             if slot is not None:
                 self._slots[j] = None
-                self._tok[j] = self._program.pad_id
-                self._t[j] = 0
+                self._release_pages(slot.pages)
+                self._clear_slot(j)
                 slot.req._fail(exc)
+        for pp in self._pending:
+            self._release_pages(pp.pages)
+            pp.req._fail(exc)
+        self._pending = []
+
+    # -- the scheduling loop ----------------------------------------------
+
+    def _active(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    def _emit(self, j: int, token: int, now: float) -> bool:
+        """Deliver one token to slot ``j``; True when the slot retired
+        (EOS or cap)."""
+        slot = self._slots[j]
+        if slot.req.t_first_token is None:
+            slot.req.t_first_token = now
+            self._ttft.record((now - slot.req.t_enqueue) * 1e3)
+        slot.tokens.append(token)
+        slot.t += 1
+        self._prev[j] = self._tok[j]
+        self._tok[j] = token
+        self._t[j] = slot.t
+        if token == self._program.eos_id or len(slot.tokens) >= slot.cap:
+            self._retire(j, now)
+            return True
+        return False
+
+    def _plain_iteration(self, n_active: int) -> None:
+        prog = self._program
+        t0 = time.perf_counter()
+        with trace.span("serve.step", active=n_active):
+            if self._paged:
+                nxt, self._state = prog.step(
+                    self._params, self._state, self._tok, self._t,
+                    self._pages.copy())
+            else:
+                nxt, self._state = prog.step(
+                    self._params, self._state, self._tok, self._t)
+            nxt = np.asarray(nxt)  # block: tokens ready
+        now = time.perf_counter()
+        self._step_ms.record((now - t0) * 1e3)
+        self._steps.inc()
+        self._occupancy.record(n_active / self._S)
+        emitted = 0
+        for j in range(self._S):
+            if self._slots[j] is None:
+                continue
+            self._emit(j, int(nxt[j]), now)
+            emitted += 1
+        self._tokens.inc(emitted)
+        self._tok_times.append((now, emitted))
+
+    def _spec_iteration(self, n_active: int) -> None:
+        """One speculative iteration: draft proposes k tokens, the
+        target verifies k+1 in one dispatch, each slot accepts its
+        longest agreeing prefix (1..k+1 tokens). Exact under greedy:
+        proposal j is accepted iff it EQUALS the target's greedy
+        choice, and the first disagreement is replaced by that greedy
+        choice — the emitted stream is the plain greedy stream."""
+        prog = self._program
+        k = self._spec
+        t0 = time.perf_counter()
+        with trace.span("serve.spec_step", active=n_active, k=k):
+            y, props, self._state = prog.spec_step(
+                self._params, self._state, self._tok, self._t,
+                self._prev,
+                self._pages.copy() if self._paged else None)
+            y = np.asarray(y)            # [S, k+1]; blocks
+            props = np.asarray(props)    # [S, k]
+        now = time.perf_counter()
+        self._step_ms.record((now - t0) * 1e3)
+        self._steps.inc()
+        self._occupancy.record(n_active / self._S)
+        emitted = 0
+        for j in range(self._S):
+            if self._slots[j] is None:
+                continue
+            n = 1
+            while n <= k and props[j, n - 1] == y[j, n - 1]:
+                n += 1
+            self._spec_proposed.inc(k)
+            self._spec_accepted.inc(n - 1)
+            for g in range(n):
+                emitted += 1
+                if self._emit(j, int(y[j, g]), now):
+                    break
+        self._tokens.inc(emitted)
+        self._tok_times.append((now, emitted))
 
     def _loop(self) -> None:
         from parallax_tpu.serve.batcher import ServeClosed
-        prog = self._program
         while True:
             if self._stop.is_set():
                 # fast close / drain window expired: in-flight decodes
@@ -253,40 +536,23 @@ class ContinuousScheduler:
                 return
             now = time.perf_counter()
             self._expire_slots(now)
-            self._refill()
+            if self._chunks > 1:
+                self._advance_prefill()
+            else:
+                self._refill()
             n_active = self._active()
             if n_active == 0:
+                if self._pending:
+                    continue  # keep prefill chunks flowing
                 if self._queue.closed and len(self._queue) == 0:
                     return
                 self._kick.wait(0.02)
                 self._kick.clear()
                 continue
-            t0 = time.perf_counter()
-            with trace.span("serve.step", active=n_active):
-                nxt, self._state = prog.step(self._params, self._state,
-                                             self._tok, self._t)
-                nxt = np.asarray(nxt)  # block: tokens ready
-            now = time.perf_counter()
-            self._step_ms.record((now - t0) * 1e3)
-            self._steps.inc()
-            self._occupancy.record(n_active / self._S)
-            emitted = 0
-            for j, slot in enumerate(self._slots):
-                if slot is None:
-                    continue
-                token = int(nxt[j])
-                if slot.req.t_first_token is None:
-                    slot.req.t_first_token = now
-                    self._ttft.record((now - slot.req.t_enqueue) * 1e3)
-                slot.tokens.append(token)
-                emitted += 1
-                slot.t += 1
-                self._tok[j] = token
-                self._t[j] = slot.t
-                if token == prog.eos_id or len(slot.tokens) >= slot.cap:
-                    self._retire(j, now)
-            self._tokens.inc(emitted)
-            self._tok_times.append((now, emitted))
+            if self._spec:
+                self._spec_iteration(n_active)
+            else:
+                self._plain_iteration(n_active)
 
     def drain(self, timeout_s: float) -> None:
         """After ``queue.close()``: wait for in-flight + queued decodes
@@ -303,11 +569,13 @@ class ContinuousScheduler:
                 "serve decode thread did not stop within the drain "
                 "window; in-flight requests may hang until their "
                 "result() timeout")
-        # unhook the gauge: its set_fn pins this scheduler (and the
+        # unhook the gauges: their set_fns pin this scheduler (and the
         # device KV caches) inside a possibly long-lived shared
-        # registry; after close it must read as plain None, not sample
-        # a dead scheduler
+        # registry; after close they must read as plain None, not
+        # sample a dead scheduler
         self.metrics.gauge("serve.tokens_per_sec").set_fn(None)
+        if self._spec:
+            self.metrics.gauge("serve.spec_accept_rate").set_fn(None)
         self._state = None
 
 

@@ -1,0 +1,121 @@
+"""Block-paged KV memory: a fixed pool of fixed-size pages plus
+per-sequence page tables (vLLM-style paged attention, PAPERS.md).
+
+The dense continuous-decode layout keeps one ``[L, S, T, D]`` self-KV
+buffer per slot set: every slot pays ``max_len`` positions of cache
+whether its sequence uses them or not, so max concurrency is bound by
+``slots x max_len`` memory. The paged layout replaces it with ONE pool
+``[L, pool_pages, page_size, D]`` shared by every slot; a sequence owns
+``ceil(cap / page_size)`` pages for exactly as long as it is in flight,
+so max concurrent sequences is bounded by **pool memory, not slot
+count** — the slot count can be raised 8-64x and admission is governed
+by page availability.
+
+This module is the HOST side: a pure allocator over page ids. It never
+touches device memory — the device pool and the gather-based attention
+over page tables live in models/nmt.py (``_decode_tokens_cached``) and
+serve/adapters.py; the continuous scheduler (serve/continuous.py) calls
+``alloc`` at slot refill and ``free`` at retire.
+
+Correctness contract (tested as a pure unit in tests/test_paged_kv.py):
+
+* ``alloc(n)`` either returns exactly ``n`` distinct free pages or
+  raises :class:`PagePoolExhausted` **without changing any state** —
+  refusal is loud and deterministic, never a partial grant;
+* ``free`` returns pages to the pool for reuse and refuses double-free
+  and foreign ids;
+* a reused page never leaks stale K/V into a refilled slot: the device
+  step masks every cache position ``> t`` and every position ``<= t``
+  is freshly written after the refill, so the allocator needs no page
+  zeroing (same argument as the dense layout's slot reuse).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+class PagePoolExhausted(RuntimeError):
+    """``alloc`` could not grant the request from the free pool.
+
+    Raised deterministically (the pool state is left untouched); the
+    continuous scheduler treats it as "defer this refill" — the request
+    stays queued until a retiring sequence frees pages — and counts the
+    deferral in ``serve.kv_refill_deferred``.
+    """
+
+
+class PageAllocator:
+    """Host-side allocator over ``pool_pages`` page ids ``0..n-1``.
+
+    Free pages are handed out LIFO so a just-retired sequence's pages
+    are the next refill's pages — maximal reuse churn, which is exactly
+    what the no-stale-visibility test needs to exercise.
+    """
+
+    def __init__(self, pool_pages: int):
+        n = int(pool_pages)
+        if n < 1:
+            raise ValueError(f"pool_pages must be >= 1, got {pool_pages}")
+        self.pool_pages = n
+        self._free: List[int] = list(range(n - 1, -1, -1))
+        self._in_use: set = set()
+        self.high_water = 0
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return len(self._in_use)
+
+    def can_alloc(self, n: int) -> bool:
+        return 0 <= n <= len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Grant ``n`` pages or raise :class:`PagePoolExhausted` with
+        the pool untouched (all-or-nothing)."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"alloc needs n >= 1, got {n}")
+        if n > len(self._free):
+            raise PagePoolExhausted(
+                f"need {n} page(s), {len(self._free)} free of "
+                f"{self.pool_pages} (in use: {len(self._in_use)})")
+        pages = [self._free.pop() for _ in range(n)]
+        self._in_use.update(pages)
+        self.high_water = max(self.high_water, len(self._in_use))
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Return ``pages`` to the pool; refuses double-free / foreign
+        ids loudly (a silent accept would let two sequences share a
+        page and corrupt each other's cache)."""
+        pages = list(pages)
+        bad = [p for p in pages if p not in self._in_use]
+        if bad:
+            raise ValueError(
+                f"free of page(s) {bad} not currently allocated "
+                f"(double-free or foreign id)")
+        if len(set(pages)) != len(pages):
+            raise ValueError(f"duplicate page ids in free: {pages}")
+        for p in pages:
+            self._in_use.discard(p)
+            self._free.append(p)
+
+    def stats(self) -> dict:
+        return {"pool_pages": self.pool_pages,
+                "in_use": self.in_use,
+                "free": self.free_pages,
+                "high_water": self.high_water}
+
+
+def pages_for(tokens: int, page_size: int) -> int:
+    """Pages needed to hold ``tokens`` positions."""
+    if tokens < 1:
+        raise ValueError(f"tokens must be >= 1, got {tokens}")
+    return -(-int(tokens) // int(page_size))
+
+
+__all__ = ["PageAllocator", "PagePoolExhausted", "pages_for"]

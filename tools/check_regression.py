@@ -25,6 +25,14 @@ Explanations accepted for an over-threshold move between comparable
 rounds: a ``regression_note`` string in the current artifact (a human
 wrote down why). Anything else over the threshold fails.
 
+**Secondary gates** (ISSUE 6): between harness-compatible rounds the
+``serve`` and ``decode`` blocks are gated the same way the training
+headline is — one-shot QPS, continuous-decode tokens/sec and TTFT,
+and the cached-decode latency row must not regress unexplained. A
+gate whose value is missing on either side is SKIPPED (reported), so
+adding a new sub-block never fails the round that introduces it; the
+global ``regression_note`` explains secondary moves too.
+
 Artifacts are accepted in both layouts: the driver wrapper
 (``{"parsed": {...}}``, what lands in the repo root) and the raw
 bench.py JSON line. Failed rounds (``value`` 0 / ``error`` set) never
@@ -175,6 +183,77 @@ def compare(current: Optional[dict], previous: Optional[dict],
     return out
 
 
+# (dotted path, higher_is_better) — a negative list index addresses
+# from the end (the decode rows' largest target length)
+SECONDARY_GATES = (
+    ("serve.qps", True),
+    ("serve.latency_ms.p50", False),
+    ("serve.continuous.tokens_per_sec_best", True),
+    ("serve.continuous.ttft_ms_p50_at_8x", False),
+    ("decode.rows.-1.cached_ms", False),
+    ("decode.spec_vs_plain.tokens_per_sec_spec", True),
+    ("decode.paged_vs_dense.paged_step_ms", False),
+)
+
+
+def _get(doc, dotted):
+    """Resolve ``a.b.-1.c`` through dicts and lists; None when any hop
+    is missing or mistyped."""
+    cur = doc
+    for part in dotted.split("."):
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        elif isinstance(cur, (list, tuple)):
+            try:
+                cur = cur[int(part)]
+            except (ValueError, IndexError):
+                return None
+        else:
+            return None
+    return cur
+
+
+def compare_secondary(current: dict, previous: dict,
+                      max_drop: float = DEFAULT_MAX_DROP,
+                      gates=SECONDARY_GATES) -> list:
+    """Gate the serve/decode sub-blocks between two HARNESS-COMPATIBLE
+    rounds (the caller has already established primary comparability).
+    Returns one verdict per gate: ``ok`` / ``regression`` /
+    ``explained`` (global ``regression_note``) / ``skipped`` (value
+    absent on either side)."""
+    note = current.get("regression_note")
+    out = []
+    for path, higher_better in gates:
+        cur_v, prev_v = _get(current, path), _get(previous, path)
+        row = {"gate": path, "higher_is_better": higher_better,
+               "current": cur_v, "previous": prev_v}
+        if not isinstance(cur_v, (int, float)) \
+                or not isinstance(prev_v, (int, float)) \
+                or prev_v <= 0 or cur_v <= 0:
+            row["status"] = "skipped"
+            row["why"] = "value missing or non-positive on one side"
+            out.append(row)
+            continue
+        ratio = cur_v / prev_v
+        row["ratio"] = round(ratio, 4)
+        worse = (ratio < 1.0 - max_drop) if higher_better \
+            else (ratio > 1.0 / (1.0 - max_drop))
+        if worse:
+            if note:
+                row["status"] = "explained"
+                row["why"] = f"regression_note: {note}"
+            else:
+                row["status"] = "regression"
+                row["why"] = (f"moved {round((ratio - 1) * 100, 2)}% "
+                              f"in the bad direction (> "
+                              f"{max_drop * 100:.0f}%) with no "
+                              f"explanation in-artifact")
+        else:
+            row["status"] = "ok"
+        out.append(row)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
@@ -208,13 +287,25 @@ def main(argv=None) -> int:
         print(json.dumps({"status": "no_data",
                           "why": "no BENCH_r*.json artifacts found"}))
         return 2
-    result = compare(load_bench(cur_path),
-                     load_bench(prev_path) if prev_path else None,
-                     max_drop=args.max_drop, max_rise=args.max_rise)
+    cur = load_bench(cur_path)
+    prev = load_bench(prev_path) if prev_path else None
+    result = compare(cur, prev, max_drop=args.max_drop,
+                     max_rise=args.max_rise)
     result["current_path"] = cur_path
     result["previous_path"] = prev_path
+    # secondary serve/decode gates apply only between rounds the
+    # primary comparison established as harness-compatible (same
+    # bench_version; a version bump re-baselines the sub-blocks too)
+    if (result["status"] in ("ok", "regression", "suspicious_rise",
+                             "explained")
+            and isinstance(cur, dict) and isinstance(prev, dict)
+            and cur.get("bench_version") == prev.get("bench_version")):
+        result["secondary"] = compare_secondary(
+            cur, prev, max_drop=args.max_drop)
     print(json.dumps(result, indent=2))
-    if result["status"] == "regression":
+    if result["status"] == "regression" or any(
+            r["status"] == "regression"
+            for r in result.get("secondary", [])):
         return 1
     if result["status"] == "no_data":
         # fail CLOSED on unreadable/missing artifacts: a gate that

@@ -33,6 +33,13 @@ the declared length buckets):
   on-path measurement (``serve.batcher_overhead_ms``, which on a
   loaded box also absorbs GIL contention from the client threads) is
   reported for eyeballing, not asserted.
+* **continuous-decode signature closure** (ISSUE 6) — the ENLARGED
+  signature set of the paged/chunked/speculative decode path (page
+  tables, every prefill chunk, draft step, verify step, insert against
+  both fresh and stepped state) is AOT-warmed at construction; under a
+  mixed-length decode load with retire/refill and page churn the
+  ``jax.monitoring`` compile listener must stay at ZERO and every
+  request must complete.
 """
 
 from __future__ import annotations
@@ -197,6 +204,36 @@ def measure(n_requests: int = 96, concurrency: int = 4,
     finally:
         sess2.close()
 
+    # -- phase 3: continuous decode over the ENLARGED signature set ----
+    # paged KV + chunked prefill + speculative draft/verify, mixed
+    # source lengths and mixed caps: retire/refill churn and page reuse
+    # must dispatch AOT executables only
+    dsess, dmake = loadgen.demo_decode_session(
+        slots=8, T=12, Ts=8, page_size=4, model_dim=32,
+        prefill_chunk_layers=1, spec_tokens=2)
+    try:
+        _compile_events["n"] = 0
+        _compile_events["active"] = True
+        decode_report = loadgen.run_load(dsess, dmake, 24,
+                                         concurrency=8)
+        _compile_events["active"] = False
+        decode_compiles = _compile_events["n"]
+        dstats = dsess.stats()
+    finally:
+        dsess.close()
+    decode = {
+        "completed": decode_report["completed"],
+        "failed": decode_report["failed"],
+        "tokens": decode_report["tokens"],
+        "tokens_per_sec": decode_report["tokens_per_sec"],
+        "ttft_ms": decode_report["ttft_ms"],
+        "serve_time_xla_compiles": decode_compiles,
+        "kv_pages_in_use_after": dstats.get("serve.kv_pages_in_use"),
+        "kv_refill_deferred": dstats.get("serve.kv_refill_deferred", 0),
+        "spec_accept_rate": dstats.get("serve.spec_accept_rate"),
+        "prefill_chunks": dstats.get("serve.prefill_chunks"),
+    }
+
     def _p50(h):
         return h["p50"] if isinstance(h, dict) else None
 
@@ -223,6 +260,7 @@ def measure(n_requests: int = 96, concurrency: int = 4,
         "onpath_overhead_frac": (round(measured, 5)
                                  if measured is not None else None),
         "batch_occupancy": stats.get("serve.batch_occupancy"),
+        "decode": decode,
         "burst": {
             "submitted": burst["submitted"],
             "shed": burst["shed"],
@@ -268,6 +306,17 @@ def check(result: dict, max_overhead: float = 0.05) -> list:
     elif result["overhead_frac"] > max_overhead:
         bad.append(f"batcher overhead {result['overhead_frac']} > "
                    f"{max_overhead} of step wall-time")
+    d = result.get("decode") or {}
+    if d.get("serve_time_xla_compiles", 0) != 0:
+        bad.append(f"{d['serve_time_xla_compiles']} XLA compile(s) "
+                   f"fired during continuous decode — the enlarged "
+                   f"signature set (page tables / prefill chunks / "
+                   f"draft+verify) leaked")
+    if d.get("completed", 0) == 0 or d.get("failed", 0):
+        bad.append(f"decode load did not complete cleanly: {d}")
+    if d.get("kv_pages_in_use_after", 0) != 0:
+        bad.append(f"{d['kv_pages_in_use_after']} KV page(s) leaked "
+                   f"after all decode sequences retired")
     return bad
 
 

@@ -3,22 +3,31 @@
 Drives a :class:`~parallax_tpu.serve.session.ServeSession` with
 closed-loop clients (each thread submits, waits for the result, then
 submits again — the standard saturating-load shape) over a caller-
-supplied feed generator, and reports per-request outcomes alongside
-the session's own ``serve.*`` metrics. Used by
-``tools/check_serve_slo.py`` (the tier-1 SLO contract), the BENCH
-"serve" section (bench.py), and runnable directly::
+supplied feed generator, and reports per-request outcomes (latency,
+time-to-first-token, emitted tokens) alongside the session's own
+``serve.*`` metrics. Used by ``tools/check_serve_slo.py`` (the tier-1
+SLO contract), the BENCH "serve" section (bench.py), and runnable
+directly::
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python tools/loadgen.py
 
 which serves a small MLP scorer under a mixed-length load and prints
 one JSON report.
+
+**Concurrency sweep** (ISSUE 6): ``--mode decode --sweep 8,16,32,64``
+brings up one continuous-decode session per offered concurrency level
+(paged KV + chunked prefill + speculative decoding by default) and
+stamps tokens/sec and TTFT per level — the 8x-64x-concurrency claim as
+one artifact, not prose. ``sweep_decode()`` is the API bench.py stamps
+into the ``serve.continuous`` block.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import threading
@@ -28,6 +37,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def _pct(sorted_ms, q):
+    if not sorted_ms:
+        return None
+    return round(sorted_ms[min(len(sorted_ms) - 1,
+                               math.ceil(q * len(sorted_ms)) - 1)], 3)
+
+
 def run_load(session, make_feed, n_requests: int, concurrency: int = 4,
              deadline_ms=None, max_new_tokens=None,
              result_timeout_s: float = 120.0) -> dict:
@@ -35,8 +51,6 @@ def run_load(session, make_feed, n_requests: int, concurrency: int = 4,
     threads; ``make_feed(i)`` builds request ``i``'s feed. Returns the
     outcome/latency report (shed and timed-out requests are counted,
     not errors)."""
-    import numpy as np
-
     from parallax_tpu.serve import (DeadlineExceeded, ServeClosed,
                                     ServeOverloaded)
 
@@ -44,6 +58,8 @@ def run_load(session, make_feed, n_requests: int, concurrency: int = 4,
     counter = {"next": 0}
     outcomes = {"completed": 0, "shed": 0, "timeout": 0, "failed": 0}
     latencies = []
+    ttfts = []
+    tokens = [0]
     errors = []
 
     def client():
@@ -62,10 +78,15 @@ def run_load(session, make_feed, n_requests: int, concurrency: int = 4,
                     outcomes["shed"] += 1
                 continue
             try:
-                req.result(timeout=result_timeout_s)
+                res = req.result(timeout=result_timeout_s)
+                n_tok = len(res) if hasattr(res, "__len__") else 0
+                t_first = req.t_first_token or req.t_done
                 with lock:
                     outcomes["completed"] += 1
                     latencies.append(req.latency_s())
+                    tokens[0] += n_tok
+                    if t_first is not None:
+                        ttfts.append(t_first - req.t_enqueue)
             except DeadlineExceeded:
                 with lock:
                     outcomes["timeout"] += 1
@@ -85,14 +106,7 @@ def run_load(session, make_feed, n_requests: int, concurrency: int = 4,
     wall = time.perf_counter() - t0
 
     lat_ms = sorted(v * 1e3 for v in latencies)
-
-    def pct(q):
-        if not lat_ms:
-            return None
-        import math
-        return round(lat_ms[min(len(lat_ms) - 1,
-                                math.ceil(q * len(lat_ms)) - 1)], 3)
-
+    ttft_ms = sorted(v * 1e3 for v in ttfts)
     return {
         "submitted": n_requests,
         "completed": outcomes["completed"],
@@ -102,8 +116,16 @@ def run_load(session, make_feed, n_requests: int, concurrency: int = 4,
         "errors": errors[:5],
         "wall_s": round(wall, 3),
         "qps": round(outcomes["completed"] / wall, 2) if wall > 0 else None,
-        "latency_ms": {"p50": pct(0.50), "p95": pct(0.95),
+        "latency_ms": {"p50": _pct(lat_ms, 0.50), "p95": _pct(lat_ms, 0.95),
                        "max": round(lat_ms[-1], 3) if lat_ms else None},
+        # time-to-first-token, measured CLIENT-side per request (equals
+        # full latency in one-shot mode, where the only token is the
+        # result)
+        "ttft_ms": {"p50": _pct(ttft_ms, 0.50), "p95": _pct(ttft_ms, 0.95),
+                    "max": round(ttft_ms[-1], 3) if ttft_ms else None},
+        "tokens": tokens[0],
+        "tokens_per_sec": (round(tokens[0] / wall, 2)
+                           if wall > 0 and tokens[0] else None),
         "deadline_ms": deadline_ms,
         "concurrency": concurrency,
     }
@@ -157,13 +179,117 @@ def demo_session(max_batch: int = 8, length_buckets=(16, 32),
     return sess, make_feed
 
 
+def demo_decode_session(slots: int = 16, T: int = 16, Ts: int = 8,
+                        page_size: int = 4, pool_pages=None,
+                        prefill_chunk_layers=1, spec_tokens: int = 2,
+                        model_dim: int = 64, num_layers: int = 2,
+                        vocab: int = 256, max_queue: int = 4096,
+                        paged: bool = True, speculative: bool = True,
+                        metrics=None):
+    """A tiny-NMT continuous-decode session with the full ISSUE 6
+    stack on by default — paged KV pool, chunked prefill, layer-skip
+    speculative draft. Returns ``(session, make_feed)``; ``make_feed``
+    produces mixed-length sources. ``paged=False`` / ``speculative=
+    False`` select the dense / plain ablations (the A/B rigs of
+    tools/nmt_decode_timing.py and the sweep)."""
+    import jax
+    import numpy as np
+
+    import parallax_tpu as parallax
+    from parallax_tpu.models import nmt
+    from parallax_tpu.serve import NMTDecodeProgram
+
+    cfg = nmt.tiny_config(vocab_size=vocab, model_dim=model_dim,
+                          num_heads=4, mlp_dim=2 * model_dim,
+                          num_layers=num_layers, max_len=max(T, Ts),
+                          num_partitions=1)
+    params = nmt.build_model(cfg).init_fn(jax.random.PRNGKey(0))
+    kw = {}
+    if paged:
+        if pool_pages is None:
+            pool_pages = slots * (T // page_size)
+        kw.update(page_size=page_size, pool_pages=pool_pages)
+    if prefill_chunk_layers:
+        kw.update(prefill_chunk_layers=prefill_chunk_layers)
+    if speculative and spec_tokens:
+        from parallax_tpu.serve.adapters import layer_skip_draft
+        dcfg, dparams = layer_skip_draft(cfg, params)
+        kw.update(spec_tokens=spec_tokens, draft_cfg=dcfg,
+                  draft_params=dparams)
+    prog = NMTDecodeProgram(cfg, max_src_len=Ts, max_len=T, **kw)
+    pcfg = parallax.Config(serve_config=parallax.ServeConfig(
+        max_batch=slots, max_queue=max_queue))
+    sess = parallax.ServeSession(program=prog, params=params,
+                                 config=pcfg, metrics=metrics)
+
+    def make_feed(i):
+        r = np.random.default_rng(2000 + i)
+        L = int(r.integers(max(2, Ts // 2), Ts + 1))
+        return {"src": r.integers(3, vocab, (L,)).astype(np.int32)}
+
+    return sess, make_feed
+
+
+def sweep_decode(levels=(8, 16, 32, 64), requests_per_level=None,
+                 result_timeout_s: float = 300.0, **session_kw) -> list:
+    """The concurrency sweep: one fresh continuous-decode session per
+    offered level (slots == offered closed-loop clients), tokens/sec
+    and TTFT stamped per level. Sessions are rebuilt per level so
+    every row starts from a cold queue and clean metrics; warmup
+    compiles happen at construction, OUTSIDE the measured window."""
+    rows = []
+    for level in levels:
+        n_req = requests_per_level or max(2 * level, 16)
+        sess, make_feed = demo_decode_session(slots=level, **session_kw)
+        try:
+            rep = run_load(sess, make_feed, n_req, concurrency=level,
+                           result_timeout_s=result_timeout_s)
+            stats = sess.stats()
+        finally:
+            sess.close()
+        rows.append({
+            "offered_concurrency": level,
+            "slots": level,
+            "requests": n_req,
+            "completed": rep["completed"],
+            "failed": rep["failed"],
+            "tokens": rep["tokens"],
+            "tokens_per_sec": rep["tokens_per_sec"],
+            "ttft_ms": rep["ttft_ms"],
+            "latency_ms": rep["latency_ms"],
+            "qps": rep["qps"],
+            "recompiles": stats.get("serve.recompiles", 0),
+            "kv_pages_in_use_after": stats.get("serve.kv_pages_in_use"),
+            "kv_refill_deferred": stats.get("serve.kv_refill_deferred",
+                                            0),
+            "spec_accept_rate": stats.get("serve.spec_accept_rate"),
+            "decode_steps": stats.get("serve.decode_steps"),
+        })
+        print(f"# sweep level {level}: {rep['tokens_per_sec']} tok/s, "
+              f"ttft p50 {rep['ttft_ms']['p50']}ms", flush=True)
+    return rows
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=64)
     ap.add_argument("--concurrency", type=int, default=4)
     ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--mode", choices=("oneshot", "decode"),
+                    default="oneshot")
+    ap.add_argument("--sweep", type=str, default=None,
+                    help="comma-separated offered-concurrency levels; "
+                         "decode mode only (e.g. 8,16,32,64)")
     args = ap.parse_args(argv)
-    sess, make_feed = demo_session()
+    if args.sweep:
+        levels = tuple(int(x) for x in args.sweep.split(","))
+        rows = sweep_decode(levels=levels)
+        print(json.dumps({"sweep": rows}, indent=2, default=str))
+        return 0 if all(r["failed"] == 0 for r in rows) else 1
+    if args.mode == "decode":
+        sess, make_feed = demo_decode_session()
+    else:
+        sess, make_feed = demo_session()
     try:
         report = run_load(sess, make_feed, args.requests,
                           concurrency=args.concurrency,
