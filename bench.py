@@ -45,6 +45,11 @@ PROBE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #               (ISSUE 6). The version bump exists so the regression
 #               gate re-baselines the enlarged blocks; the same-build
 #               A/B under v2 params attributes any headline move.
+#               r7+: the serve block additionally carries a "fleet"
+#               sub-block (chaos-harness failover/hot-swap latencies,
+#               ISSUE 7) — a new sub-block, not a methodology change:
+#               the regression gate SKIPS keys absent on either side,
+#               so no version bump.
 BENCH_VERSION = 3
 BASELINE_BASIS = ("sampled-softmax vs full-softmax LM1B at the same "
                   "memory-limited batch; headline measured separately at "
@@ -513,6 +518,20 @@ def worker_main():
                     "recompiles": sum(r.get("recompiles", 0)
                                       for r in rows),
                 }
+            # Fleet robustness block (ISSUE 7): the chaos harness run
+            # end to end — injected replica crash with failover and a
+            # mid-traffic weight hot-swap over a 2-replica decode
+            # fleet; failover recovery latency and hot-swap blackout
+            # window tracked per round (secondary-gated by
+            # tools/check_regression.py). PARALLAX_BENCH_FLEET=0 skips.
+            if os.environ.get("PARALLAX_BENCH_FLEET", "1") != "0":
+                from tools import check_fleet_faults
+                fres = check_fleet_faults.measure()
+                fviol = check_fleet_faults.check(fres)
+                serve_snap["fleet"] = dict(
+                    fres["bench"],
+                    ok=not fviol,
+                    violations=fviol[:3] or None)
         except Exception as e:
             print(f"# serve bench failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)

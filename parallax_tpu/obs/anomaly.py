@@ -87,6 +87,18 @@ class _SignalDetector:
     # rolling baseline doesn't need)
     REFRESH = 8
 
+    def rebaseline(self) -> None:
+        """Forget the baseline and hold fire for ``cooldown`` further
+        observations — the new level becomes the new normal. Called on
+        a detected shift, and externally for DELIBERATE level changes
+        (a fleet scale event, a weight hot-swap): planned operations
+        must not read as change-point anomalies."""
+        self.window.clear()
+        self._recent.clear()
+        self._recent_sum = 0.0
+        self._stale = 0
+        self._cooldown_until = self._n + int(self.cfg.cooldown)
+
     def observe(self, step: int, value: float) -> Optional[AnomalyEvent]:
         cfg = self.cfg
         self._n += 1
@@ -194,6 +206,28 @@ class AnomalyMonitor:
                     # happened to trip the detector
                     pass
         return event
+
+    def notify_deliberate_change(self, reason: str = "",
+                                 signals: Optional[List[str]] = None
+                                 ) -> None:
+        """A DELIBERATE level change is about to happen (or just did):
+        a fleet scale-up/down, a replica ejection's failover surge, a
+        weight hot-swap. Rebaseline the named signals' detectors (all
+        of them by default) — the post-event level becomes the new
+        normal after ``cooldown`` observations instead of firing a
+        false change-point the step the operation lands
+        (ISSUE 7; the serving fleet calls this on every scale/swap/
+        ejection event). Counted in ``anomaly.deliberate_changes``."""
+        with self._lock:
+            for name, det in self._detectors.items():
+                if signals is None or name in signals:
+                    det.rebaseline()
+        self.registry.counter("anomaly.deliberate_changes").inc()
+        if reason:
+            from parallax_tpu.common.lib import parallax_log
+            parallax_log.info(
+                "anomaly: rebaselined for deliberate change: %s",
+                reason)
 
     def events(self) -> List[dict]:
         """JSON-ready copies of the recent events (flight dumps)."""

@@ -19,24 +19,46 @@ request path of the ROADMAP north star ("serving heavy traffic"):
   * :mod:`~parallax_tpu.serve.adapters` — DecodeProgram bindings for
     the repo's models (NMT greedy decode).
 
-Knobs live on ``Config(serve_config=ServeConfig(...))``; ``serve.*``
-metrics and per-request spans land in ``obs/``;
-``tools/check_serve_slo.py`` enforces the serving SLO contract (zero
-serve-time recompiles, deadline discipline, batcher overhead <= 5% of
-step wall-time) in tier-1.
+The fault-tolerant tier above single sessions (ISSUE 7):
+
+  * :class:`~parallax_tpu.serve.fleet.ServeFleet` — N engine replicas
+    behind a health-aware router: queue-depth placement, failover
+    retry within the original deadline, zero-downtime weight hot-swap
+    (``push_weights``), optional autoscaling.
+  * :mod:`~parallax_tpu.serve.router` — replica health states
+    (healthy/degraded/ejected) from heartbeat, error-rate and latency
+    probes, with circuit-breaker re-admission on exponential backoff.
+  * :mod:`~parallax_tpu.serve.faults` — the deterministic chaos
+    harness (injected crash / stall / NaN / saturation) behind
+    ``tools/check_fleet_faults.py``.
+
+Knobs live on ``Config(serve_config=ServeConfig(...))`` (fleet knobs
+on :class:`FleetConfig`); ``serve.*`` / ``fleet.*`` metrics and
+per-request spans land in ``obs/``; ``tools/check_serve_slo.py``
+enforces the serving SLO contract (zero serve-time recompiles,
+deadline discipline, batcher overhead <= 5% of step wall-time) and
+``tools/check_fleet_faults.py`` the fleet chaos contract (crash
+failover + mid-traffic hot-swap with zero dropped accepted requests
+and zero recompiles) in tier-1.
 """
 
 from parallax_tpu.common.config import ServeConfig
 from parallax_tpu.serve.adapters import (NMTDecodeProgram,
                                          layer_skip_draft)
 from parallax_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
-                                        Request, RequestQueue,
-                                        ServeClosed, ServeError,
-                                        ServeOverloaded)
+                                        ReplicaUnavailable, Request,
+                                        RequestQueue, ServeClosed,
+                                        ServeError, ServeOverloaded)
 from parallax_tpu.serve.continuous import (ContinuousScheduler,
                                            DecodeProgram)
+from parallax_tpu.serve.faults import (FaultInjector, InjectedFault,
+                                       ReplicaCrash)
+from parallax_tpu.serve.fleet import (FleetConfig, FleetRequest,
+                                      ServeFleet)
 from parallax_tpu.serve.paging import (PageAllocator, PagePoolExhausted,
                                        pages_for)
+from parallax_tpu.serve.router import (HealthPolicy, ReplicaHandle,
+                                       Router)
 from parallax_tpu.serve.session import ServeSession
 
 __all__ = [
@@ -44,5 +66,8 @@ __all__ = [
     "MicroBatcher", "ContinuousScheduler", "DecodeProgram",
     "NMTDecodeProgram", "layer_skip_draft", "PageAllocator",
     "PagePoolExhausted", "pages_for", "ServeError", "ServeOverloaded",
-    "DeadlineExceeded", "ServeClosed",
+    "DeadlineExceeded", "ServeClosed", "ReplicaUnavailable",
+    "ServeFleet", "FleetConfig", "FleetRequest", "Router",
+    "ReplicaHandle", "HealthPolicy", "FaultInjector", "InjectedFault",
+    "ReplicaCrash",
 ]

@@ -38,19 +38,61 @@ from parallax_tpu.obs import trace
 
 
 class ServeError(RuntimeError):
-    """Base class of serving-layer request failures."""
+    """Base class of serving-layer request failures.
+
+    Two class attributes declare the transient-vs-permanent taxonomy
+    (ISSUE 7) ON the exception, so retry logic reads a declared
+    property instead of pattern-matching type names:
+
+    * ``retryable`` — another attempt (typically on a DIFFERENT
+      replica, within the original deadline) may succeed. The fleet
+      router consults this when a sub-request fails.
+    * ``fatal`` — the replica that raised it is DEAD: the serving loop
+      that observes it stops, fails everything it holds with
+      :class:`ReplicaUnavailable`, and reports ``on_fatal`` so the
+      fleet can eject the replica and fail work over.
+    """
+
+    retryable = False
+    fatal = False
 
 
 class ServeOverloaded(ServeError):
-    """Admission control shed this request (queue at ``max_queue``)."""
+    """Admission control shed this request (queue at ``max_queue``).
+
+    Transient: the queue is full NOW — a different replica (or a later
+    retry) may have headroom."""
+
+    retryable = True
 
 
 class DeadlineExceeded(ServeError):
-    """The request's deadline expired before it was served."""
+    """The request's deadline expired before it was served.
+
+    Permanent: the budget is spent — retrying elsewhere cannot unmiss
+    a deadline."""
+
+    retryable = False
 
 
 class ServeClosed(ServeError):
-    """The session closed before this request could be served."""
+    """The session closed before this request could be served.
+
+    Permanent for the session the caller submitted to (the fleet maps
+    a replica-side close into :class:`ReplicaUnavailable` instead)."""
+
+    retryable = False
+
+
+class ReplicaUnavailable(ServeError):
+    """The replica holding this request died or was ejected before
+    completing it (crash, non-finite output, forced ejection).
+
+    Transient at the fleet tier: the request was accepted but never
+    served — nothing was delivered, so a retry on a healthy replica
+    cannot double-serve it."""
+
+    retryable = True
 
 
 _req_ids = itertools.count()
@@ -68,7 +110,7 @@ class Request:
 
     __slots__ = ("id", "feed", "deadline", "group_key", "max_new_tokens",
                  "t_enqueue", "t_done", "t_first_token", "_event",
-                 "_result", "_error")
+                 "_result", "_error", "_callbacks")
 
     def __init__(self, feed: Dict[str, Any],
                  deadline: Optional[float] = None,
@@ -85,6 +127,7 @@ class Request:
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
+        self._callbacks: List[Callable] = []
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -105,15 +148,42 @@ class Request:
         return (None if self.t_done is None
                 else self.t_done - self.t_enqueue)
 
+    def add_done_callback(self, fn: Callable[["Request"], None]) -> None:
+        """``fn(request)`` runs exactly once when the request completes
+        or fails — immediately (on the calling thread) if it already
+        did, else on whichever thread delivers the outcome. The fleet
+        chains sub-request outcomes to its own futures through this
+        instead of burning a watcher thread per request. Callback
+        exceptions are swallowed (a broken observer must not fail the
+        serving loop)."""
+        self._callbacks.append(fn)
+        if self._event.is_set():
+            self._drain_callbacks()
+
+    def _drain_callbacks(self) -> None:
+        # list.pop is atomic under the GIL: however many threads race
+        # here, each callback is popped (and therefore invoked) once
+        while True:
+            try:
+                fn = self._callbacks.pop(0)
+            except IndexError:
+                return
+            try:
+                fn(self)
+            except Exception:
+                pass
+
     def _complete(self, result) -> None:
         self.t_done = time.perf_counter()
         self._result = result
         self._event.set()
+        self._drain_callbacks()
 
     def _fail(self, exc: BaseException) -> None:
         self.t_done = time.perf_counter()
         self._error = exc
         self._event.set()
+        self._drain_callbacks()
 
 
 class RequestQueue:
@@ -291,22 +361,65 @@ class MicroBatcher:
     :class:`RequestQueue` and hands them to ``run_batch(requests)``
     (the session's pad-place-infer-split callback) on a dedicated
     daemon thread. A ``run_batch`` failure fails exactly that batch's
-    requests — the loop (and every other request) survives."""
+    requests — the loop (and every other request) survives — UNLESS
+    the exception declares ``fatal = True`` (an injected replica crash,
+    or any condition after which the replica cannot be trusted): then
+    the loop fails the batch AND everything still queued with
+    :class:`ReplicaUnavailable`, closes admission, reports ``on_fatal``
+    and exits — a dead replica fails fast instead of serving garbage
+    or hanging its clients.
+
+    ``heartbeat`` is refreshed every loop pass (including idle polls);
+    the fleet router treats a stale heartbeat as a stalled replica.
+    ``on_error(exc, n)`` reports every failed batch (the router's
+    error-rate signal); ``alive`` flips False on the fatal path.
+    """
 
     def __init__(self, queue: RequestQueue, run_batch: Callable,
                  max_batch: int, max_wait_ms: float,
-                 name: str = "parallax-serve-batcher"):
+                 name: str = "parallax-serve-batcher",
+                 on_error: Optional[Callable] = None,
+                 on_fatal: Optional[Callable] = None):
         self._queue = queue
         self._run_batch = run_batch
         self._max_batch = int(max_batch)
         self._max_wait_s = float(max_wait_ms) / 1e3
         self._stop = threading.Event()
+        self._on_error = on_error
+        self._on_fatal = on_fatal
+        self.alive = True
+        self.busy = False
+        self.heartbeat = time.perf_counter()
         self._thread = threading.Thread(target=self._loop, name=name,
                                         daemon=True)
         self._thread.start()
 
+    def _die(self, batch, cause: BaseException) -> None:
+        """Fatal path: this replica is done serving. The in-flight
+        batch and the whole queue fail with ReplicaUnavailable (the
+        RETRYABLE wrapper — nothing was delivered, so a fleet retry
+        cannot double-serve), admission closes, on_fatal fires."""
+        self.alive = False
+        err = ReplicaUnavailable(
+            f"serving replica died: {type(cause).__name__}: {cause}")
+        err.__cause__ = cause
+        for r in batch:
+            if not r.done():
+                r._fail(err)
+        self._queue.close()
+        n = self._queue.fail_all(err)
+        parallax_log.error(
+            "serve batcher died (%s); failed %d queued request(s)",
+            cause, n)
+        if self._on_fatal is not None:
+            try:
+                self._on_fatal(cause)
+            except Exception:
+                pass
+
     def _loop(self) -> None:
         while True:
+            self.heartbeat = time.perf_counter()
             if self._stop.is_set():
                 return
             batch = self._queue.form_group(self._max_batch,
@@ -321,9 +434,19 @@ class MicroBatcher:
                             "session closed without drain"))
                     continue
                 try:
+                    self.busy = True
                     with trace.span("serve.batch", n=len(batch)):
                         self._run_batch(batch)
-                except BaseException as e:  # fail the batch, not the loop
+                except BaseException as e:
+                    if self._on_error is not None:
+                        try:
+                            self._on_error(e, len(batch))
+                        except Exception:
+                            pass
+                    if getattr(e, "fatal", False):
+                        self._die(batch, e)
+                        return
+                    # fail the batch, not the loop
                     parallax_log.warning(
                         "serve batch of %d request(s) failed: %s",
                         len(batch), e)
@@ -331,6 +454,8 @@ class MicroBatcher:
                         if not r.done():
                             r._fail(e if isinstance(e, Exception)
                                     else ServeError(str(e)))
+                finally:
+                    self.busy = False
                 continue
             if self._queue.closed and len(self._queue) == 0:
                 return

@@ -145,12 +145,21 @@ class ContinuousScheduler:
     def __init__(self, program, params, serve_config, metrics,
                  queue: RequestQueue,
                  name: str = "parallax-serve-decode",
-                 on_deadline_breach=None):
+                 on_deadline_breach=None, replica_id=None,
+                 faults=None, on_fatal=None, on_error=None):
         self._program = program
         self._params = params
         self._sc = serve_config
         self._queue = queue
         self.metrics = metrics
+        # fleet wiring (ISSUE 7): deterministic fault hooks consulted
+        # once per loop pass, and death/error reporting for the router
+        self._replica_id = replica_id
+        self._faults = faults
+        self._on_fatal = on_fatal
+        self._on_error = on_error
+        self.alive = True
+        self.heartbeat = time.perf_counter()
         # SLO-breach hook for MID-DECODE expiries (queued expiries go
         # through the queue's own on_timeout); the serve session points
         # it at the flight recorder
@@ -192,6 +201,12 @@ class ContinuousScheduler:
             metrics.gauge("serve.spec_accept_rate").set_fn(
                 self.spec_accept_rate)
         self._pending: List[_Prefill] = []
+        # True while a request is popped-from-queue but not yet
+        # activated into a slot (or parked in _pending): in that
+        # window it is invisible to both len(queue) and _active(),
+        # and idle() must NOT report quiesced — a hot-swap landing
+        # there would mix weights mid-sequence
+        self._refilling = False
 
         self._slots: List[Optional[_Slot]] = [None] * self._S
         self._tok = np.full((self._S,), program.pad_id, np.int32)
@@ -339,13 +354,17 @@ class ContinuousScheduler:
             req = self._queue.pop(timeout=0.0)
             if req is None:
                 return
-            pages = self._alloc_pages(req)
-            if pages is None:
-                self._queue.requeue_front(req)
-                return
-            with trace.span("serve.prefill", slot=j, id=req.id):
-                rs = self._program.prefill(self._params, req.feed)
-                self._activate(j, req, pages, rs)
+            self._refilling = True
+            try:
+                pages = self._alloc_pages(req)
+                if pages is None:
+                    self._queue.requeue_front(req)
+                    return
+                with trace.span("serve.prefill", slot=j, id=req.id):
+                    rs = self._program.prefill(self._params, req.feed)
+                    self._activate(j, req, pages, rs)
+            finally:
+                self._refilling = False
 
     def _free_slot(self) -> Optional[int]:
         reserved = {pp.slot for pp in self._pending}
@@ -366,11 +385,15 @@ class ContinuousScheduler:
             req = self._queue.pop(timeout=0.0)
             if req is None:
                 return
-            pages = self._alloc_pages(req)
-            if pages is None:
-                self._queue.requeue_front(req)
-                return
-            self._pending.append(_Prefill(req, j, pages))
+            self._refilling = True
+            try:
+                pages = self._alloc_pages(req)
+                if pages is None:
+                    self._queue.requeue_front(req)
+                    return
+                self._pending.append(_Prefill(req, j, pages))
+            finally:
+                self._refilling = False
         pp = self._pending[0]
         with trace.span("serve.prefill_chunk", slot=pp.slot,
                         id=pp.req.id, k=pp.k):
@@ -526,14 +549,30 @@ class ContinuousScheduler:
         self._tok_times.append((now, emitted))
 
     def _loop(self) -> None:
+        try:
+            self._run_loop()
+        except BaseException as e:
+            # replica death (injected crash, poisoned device state, a
+            # bug in the program): a silently-dead daemon thread would
+            # hang every client on result() — instead, fail everything
+            # this replica holds NOW with the retryable wrapper and
+            # report up, so a fleet can eject it and fail work over
+            self._fatal(e)
+
+    def _run_loop(self) -> None:
         from parallax_tpu.serve.batcher import ServeClosed
         while True:
+            self.heartbeat = time.perf_counter()
             if self._stop.is_set():
                 # fast close / drain window expired: in-flight decodes
                 # are failed by THIS thread (single-owner slot state)
                 self._fail_active(ServeClosed(
                     "session closed mid-decode"))
                 return
+            if self._faults is not None:
+                # chaos hook: may raise ReplicaCrash (fatal path above)
+                # or sleep through an injected stall
+                self._faults.on_dispatch(self._replica_id)
             now = time.perf_counter()
             self._expire_slots(now)
             if self._chunks > 1:
@@ -553,6 +592,58 @@ class ContinuousScheduler:
                 self._spec_iteration(n_active)
             else:
                 self._plain_iteration(n_active)
+
+    def _fatal(self, cause: BaseException) -> None:
+        """The decode loop died: fail in-flight slots, pending
+        prefills and the whole queue with ReplicaUnavailable (retryable
+        — no request ever delivered a result, so failover cannot
+        double-serve), close admission, report ``on_fatal``."""
+        from parallax_tpu.serve.batcher import ReplicaUnavailable
+        self.alive = False
+        err = ReplicaUnavailable(
+            f"decode replica died: {type(cause).__name__}: {cause}")
+        err.__cause__ = cause
+        try:
+            self._fail_active(err)
+        except Exception:
+            pass
+        self._queue.close()
+        n = self._queue.fail_all(err)
+        parallax_log.error(
+            "serve decode loop died (%s); failed %d queued request(s)",
+            cause, n)
+        if self._on_error is not None:
+            try:
+                self._on_error(cause, n)
+            except Exception:
+                pass
+        if self._on_fatal is not None:
+            try:
+                self._on_fatal(cause)
+            except Exception:
+                pass
+
+    # -- fleet hooks -------------------------------------------------------
+
+    def idle(self) -> bool:
+        """No active slots, no pending prefills, nothing queued AND no
+        request in the popped-but-not-yet-activated refill window —
+        the quiesced state a weight hot-swap requires (a swap landing
+        mid-prefill would compute the encoder under old weights and
+        decode under new ones)."""
+        return (not self._refilling and self._active() == 0
+                and not self._pending and len(self._queue) == 0)
+
+    def set_params(self, placed) -> None:
+        """Swap the target params the decode step reads (live weight
+        hot-swap). The reference is read once per iteration, so the
+        swap is atomic at an iteration boundary; the caller quiesces
+        the scheduler first (ServeFleet rotates the replica out) so no
+        sequence mixes weights mid-decode. A speculative program's
+        draft params live inside the program and are NOT swapped — a
+        stale draft only lowers the acceptance rate, never correctness
+        (verify is exact under greedy for ANY draft)."""
+        self._params = placed
 
     def drain(self, timeout_s: float) -> None:
         """After ``queue.close()``: wait for in-flight + queued decodes

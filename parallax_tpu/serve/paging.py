@@ -42,7 +42,14 @@ class PagePoolExhausted(RuntimeError):
     continuous scheduler treats it as "defer this refill" — the request
     stays queued until a retiring sequence frees pages — and counts the
     deferral in ``serve.kv_refill_deferred``.
+
+    ``retryable`` (the serve error taxonomy, ISSUE 7): transient —
+    pages free as sequences retire, so a later attempt (or a different
+    replica's pool) may succeed.
     """
+
+    retryable = True
+    fatal = False
 
 
 class PageAllocator:

@@ -47,8 +47,9 @@ from parallax_tpu.compile import bucketing
 from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
 from parallax_tpu.obs import metrics as obs_metrics, trace
 from parallax_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
-                                        Request, RequestQueue,
-                                        ServeClosed, ServeError)
+                                        ReplicaUnavailable, Request,
+                                        RequestQueue, ServeClosed,
+                                        ServeError)
 
 
 class ServeSession:
@@ -81,7 +82,9 @@ class ServeSession:
                  pad_value=0, warmup: bool = True,
                  program=None,
                  metrics: Optional[obs_metrics.MetricsRegistry] = None,
-                 flight=None):
+                 flight=None, replica_id=None, faults=None,
+                 on_fatal=None, on_error=None,
+                 check_outputs: bool = False):
         if jax.process_count() > 1:
             raise ValueError(
                 "ServeSession is single-process (each serving replica "
@@ -112,6 +115,13 @@ class ServeSession:
         # shared registry's serve.* metrics next to the training state;
         # a standalone ServeSession may pass its own (or None)
         self._flight = flight
+        # fleet wiring (ISSUE 7): replica identity, deterministic
+        # fault-injection hooks (serve/faults.py), death/error
+        # reporting, and the non-finite output guard the fleet router's
+        # error-rate probe rides on
+        self.replica_id = replica_id
+        self._faults = faults
+        self._check_outputs = bool(check_outputs)
         self._queue = RequestQueue(sc.max_queue, self.metrics,
                                    on_timeout=self._on_deadline_breach)
         self._closed = False
@@ -123,7 +133,9 @@ class ServeSession:
             self._params = self._place_params(params, model, program)
             self._scheduler = ContinuousScheduler(
                 program, self._params, sc, self.metrics, self._queue,
-                on_deadline_breach=self._on_deadline_breach)
+                on_deadline_breach=self._on_deadline_breach,
+                replica_id=replica_id, faults=faults,
+                on_fatal=on_fatal, on_error=on_error)
             self._batcher = None
             return
         self._scheduler = None
@@ -168,7 +180,9 @@ class ServeSession:
         if warmup:
             self.warmup()
         self._batcher = MicroBatcher(self._queue, self._run_batch,
-                                     sc.max_batch, sc.max_wait_ms)
+                                     sc.max_batch, sc.max_wait_ms,
+                                     on_error=on_error,
+                                     on_fatal=on_fatal)
 
     # -- planning ----------------------------------------------------------
 
@@ -297,6 +311,10 @@ class ServeSession:
         :class:`DeadlineExceeded` instead of served late.
         """
         sc = self._config.serve_config
+        if self._faults is not None:
+            # chaos hook: an armed `saturate` fault sheds here, exactly
+            # like a full queue would (ServeOverloaded, retryable)
+            self._faults.on_admission(self.replica_id)
         ddl_ms = (deadline_ms if deadline_ms is not None
                   else sc.default_deadline_ms)
         deadline = (time.perf_counter() + float(ddl_ms) / 1e3
@@ -356,6 +374,8 @@ class ServeSession:
 
     def _run_batch(self, requests) -> None:
         t_host0 = time.perf_counter()
+        fault_mode = (self._faults.on_dispatch(self.replica_id)
+                      if self._faults is not None else None)
         # deadline re-check at dispatch: form_group sheds while
         # requests WAIT, but one can expire between dequeue and here —
         # don't spend device time on a caller who already gave up
@@ -410,6 +430,25 @@ class ServeSession:
                     [(k, s) for k, s, _ in sig])
                 out = self._infer_jit(self._params, placed)
             host = jax.tree.map(np.asarray, out)  # block: result ready
+        if fault_mode == "nan":
+            # injected silent corruption: every float leaf becomes NaN
+            # AFTER the device step (serve/faults.py)
+            host = jax.tree.map(
+                lambda a: (np.full_like(a, np.nan)
+                           if np.issubdtype(np.asarray(a).dtype,
+                                            np.floating) else a), host)
+        if self._check_outputs and any(
+                np.issubdtype(np.asarray(a).dtype, np.floating)
+                and not np.all(np.isfinite(a))
+                for a in jax.tree_util.tree_leaves(host)):
+            # non-finite output is a replica-health incident, not a
+            # result: fail the batch with the RETRYABLE error (a fleet
+            # re-serves it on a healthy replica) and let on_error feed
+            # the router's error-rate probe via the batcher
+            self.metrics.counter("serve.nonfinite_batches").inc()
+            raise ReplicaUnavailable(
+                f"replica {self.replica_id!r} produced non-finite "
+                f"output for a batch of {len(requests)} request(s)")
         t_step = time.perf_counter() - t_host1
         t_host2 = time.perf_counter()
         now = t_host2
@@ -452,6 +491,91 @@ class ServeSession:
         self._batcher_ms.record(
             ((t_form - t_host0)
              + (time.perf_counter() - t_host2)) * 1e3)
+
+    # -- live weight hot-swap (ISSUE 7) ------------------------------------
+
+    def swap_params(self, params) -> None:
+        """Replace the served parameters IN PLACE — the live-weight
+        hot-swap primitive under :meth:`ServeFleet.push_weights`.
+
+        The new pytree must match the old one structurally (same
+        treedef, leaf shapes and dtypes) and is placed with the OLD
+        leaves' exact shardings on the SAME mesh, so every AOT
+        executable compiled at construction remains valid: the swap
+        costs one ``device_put``, never a recompile
+        (``serve.recompiles`` stays 0 across it). A mismatch is
+        REFUSED loudly — serving through stale executables with
+        reshaped weights would be undefined behavior, not an upgrade.
+
+        The parameter reference is read once per dispatch, so the swap
+        is atomic at a batch/iteration boundary; to guarantee no
+        *sequence* mixes weights mid-decode, quiesce first (the fleet
+        rotates the replica out of placement and waits for
+        :meth:`idle`). Counted in ``serve.hotswaps``.
+        """
+        old = self._params
+        old_leaves, old_def = jax.tree_util.tree_flatten(old)
+        new_leaves, new_def = jax.tree_util.tree_flatten(params)
+        if old_def != new_def:
+            raise ValueError(
+                "swap_params: new params tree structure differs from "
+                f"the served one ({new_def} vs {old_def})")
+        for i, (a, b) in enumerate(zip(old_leaves, new_leaves)):
+            if (np.shape(a) != np.shape(b)
+                    or engine_lib._dtype_of(a) != engine_lib._dtype_of(b)):
+                raise ValueError(
+                    f"swap_params: leaf {i} changed "
+                    f"{np.shape(a)}/{engine_lib._dtype_of(a)} -> "
+                    f"{np.shape(b)}/{engine_lib._dtype_of(b)}; the AOT "
+                    f"executable set would be invalidated — rebuild "
+                    f"the session for a different architecture")
+        shardings = jax.tree_util.tree_unflatten(
+            old_def, [x.sharding for x in old_leaves])
+        with trace.span("serve.hotswap"):
+            placed = jax.device_put(params, shardings)
+            jax.block_until_ready(jax.tree_util.tree_leaves(placed))
+        self._params = placed
+        if self._scheduler is not None:
+            self._scheduler.set_params(placed)
+        self.metrics.counter("serve.hotswaps").inc()
+        parallax_log.info("serve: hot-swapped params on replica %r "
+                          "(%d leaves, zero recompiles)",
+                          self.replica_id, len(new_leaves))
+
+    # -- fleet probes ------------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        """False once the dispatch loop died (fatal fault); a dead
+        replica sheds at admission (its queue is closed)."""
+        if self._scheduler is not None:
+            return self._scheduler.alive
+        return self._batcher is None or self._batcher.alive
+
+    @property
+    def heartbeat(self) -> float:
+        """``perf_counter`` time of the dispatch loop's last pass —
+        stale while a step stalls (the router's straggler probe)."""
+        if self._scheduler is not None:
+            return self._scheduler.heartbeat
+        return self._batcher.heartbeat
+
+    def load(self) -> float:
+        """Queued + in-flight work, the router's placement score."""
+        n = float(len(self._queue))
+        if self._scheduler is not None:
+            n += self._scheduler._active() + len(self._scheduler._pending)
+        elif self._batcher is not None and self._batcher.busy:
+            n += 1.0
+        return n
+
+    def idle(self) -> bool:
+        """Nothing queued and nothing in flight — the quiesced state a
+        hot-swap requires."""
+        if self._scheduler is not None:
+            return self._scheduler.idle()
+        return len(self._queue) == 0 and not (
+            self._batcher is not None and self._batcher.busy)
 
     # -- introspection / teardown -----------------------------------------
 

@@ -948,6 +948,19 @@ class ParallaxSession:
                             mesh=self._engine.mesh, metrics=self.metrics,
                             **kw)
 
+    def push_weights(self, fleet) -> dict:
+        """Train -> serve continuous deployment (ISSUE 7): hot-swap
+        this session's LIVE trained parameters into every replica of a
+        :class:`~parallax_tpu.serve.fleet.ServeFleet`. The fleet
+        rotates replicas out one at a time (drain -> swap -> re-admit),
+        so traffic keeps flowing and — because the swap lands on each
+        replica's existing mesh with the old leaves' shardings — the
+        AOT signature sets survive: zero serve-time recompiles. The
+        param pytree is passed as-is (device arrays; each replica
+        ``device_put``\\ s onto its own placement). Returns the
+        per-replica outcome map."""
+        return fleet.push_weights(self._state.params)
+
     # -- partition search (reference: common/partitions.py) ---------------
 
     def _record_search_time(self, dt: float) -> None:

@@ -555,6 +555,48 @@ class TestRegressionGate:
         assert cur.endswith("BENCH_r10.json")
         assert prev.endswith("BENCH_r09.json")
 
+    def test_wrapper_truncation_recovers_harness_from_tail(
+            self, tmp_path):
+        """ISSUE 7 satellite: the r05 driver wrapper truncated the
+        parsed block (no ``harness``), which made the r5->r6 gate
+        report not_comparable for want of an A/B replay. load_block
+        must backfill missing top-level keys from the raw result line
+        in the wrapper's stdout tail — parsed values win on
+        conflict — so a wrapped artifact round-trips whole."""
+        from tools.bench_artifacts import load_block
+        full = _bench_block(4000.0)
+        full["harness"] = {"bench_sha256": "abc123", "batch_size": 128,
+                          "steps_measured": 20}
+        full["serve"] = {"qps": 55.0}
+        truncated = {k: v for k, v in full.items()
+                     if k not in ("harness", "serve")}
+        truncated["value"] = 4001.0  # parsed wins on conflict
+        p = tmp_path / "BENCH_r09.json"
+        p.write_text(json.dumps({
+            "n": 9, "rc": 0,
+            "tail": ("PARALLAX INFO: noise\n" + json.dumps(full)
+                     + "\n"),
+            "parsed": truncated}))
+        blk = load_block(str(p))
+        assert blk["harness"] == full["harness"]
+        assert blk["serve"] == full["serve"]
+        assert blk["value"] == 4001.0
+        # an untruncated wrapper round-trips to itself
+        p2 = tmp_path / "BENCH_r10.json"
+        p2.write_text(json.dumps({
+            "n": 10, "rc": 0, "tail": json.dumps(full),
+            "parsed": full}))
+        assert load_block(str(p2)) == full
+        # a tail whose result line measured a DIFFERENT metric never
+        # backfills (recovering someone else's harness would be worse
+        # than recovering nothing)
+        other = dict(full, metric="other_metric")
+        p3 = tmp_path / "BENCH_r11.json"
+        p3.write_text(json.dumps({
+            "n": 11, "rc": 0, "tail": json.dumps(other),
+            "parsed": truncated}))
+        assert "harness" not in load_block(str(p3))
+
 
 # -- device peak FLOPs under a TPU stub (VERDICT r5 item 5) ---------------
 

@@ -193,6 +193,12 @@ SECONDARY_GATES = (
     ("decode.rows.-1.cached_ms", False),
     ("decode.spec_vs_plain.tokens_per_sec_spec", True),
     ("decode.paged_vs_dense.paged_step_ms", False),
+    # fleet robustness latencies (ISSUE 7, tools/check_fleet_faults):
+    # how long a crash's failed-over requests take to land on healthy
+    # replicas, and the longest fleet-wide completion gap during a
+    # rotating weight hot-swap — both must not quietly regress
+    ("serve.fleet.failover_recovery_ms", False),
+    ("serve.fleet.hotswap_blackout_ms", False),
 )
 
 

@@ -230,6 +230,91 @@ def demo_decode_session(slots: int = 16, T: int = 16, Ts: int = 8,
     return sess, make_feed
 
 
+def demo_decode_fleet(replicas: int = 2, slots: int = 4, T: int = 12,
+                      Ts: int = 8, model_dim: int = 32,
+                      num_layers: int = 2, vocab: int = 64,
+                      page_size: int = 4, paged: bool = True,
+                      max_queue: int = 4096, submesh: bool = True,
+                      fleet_config=None, faults=None, flight=None,
+                      anomaly=None, metrics=None):
+    """A replicated tiny-NMT continuous-decode :class:`ServeFleet` —
+    the chaos-harness rig (tools/check_fleet_faults.py) and the bench
+    ``serve.fleet`` block.
+
+    Every replica is a full ServeSession (own scheduler thread, own
+    queue) on its own submesh when the device count splits
+    (``submesh=True``), else on one shared mesh. All replicas share
+    ONE program instance and one host param pytree, so replica
+    spin-up rides the jit caches — the first replica compiles, the
+    rest come up compile-free (the PR 3 cache story at fleet scale).
+    Greedy decode is deterministic, so every replica emits
+    bit-identical tokens for the same request — the property failover
+    retry leans on. Returns ``(fleet, make_feed, params, cfg)``;
+    ``make_feed(i)`` is deterministic per ``i`` so an unfaulted
+    baseline can replay the exact request set."""
+    import jax
+    import numpy as np
+
+    import parallax_tpu as parallax
+    from parallax_tpu.core import mesh as mesh_lib
+    from parallax_tpu.models import nmt
+    from parallax_tpu.serve import (FleetConfig, NMTDecodeProgram,
+                                    ServeFleet, ServeSession)
+
+    import jax.numpy as jnp
+    # f32 compute: the bit-identity bar (failover retries vs standalone
+    # greedy) holds exactly in f32; bf16 rounding differences between
+    # the batched cached step and the reference decode can flip argmax
+    # at near-ties, which is a dtype artifact, not a fleet bug
+    cfg = nmt.tiny_config(vocab_size=vocab, model_dim=model_dim,
+                          num_heads=4, mlp_dim=2 * model_dim,
+                          num_layers=num_layers, max_len=max(T, Ts),
+                          num_partitions=1,
+                          compute_dtype=jnp.float32)
+    params = nmt.build_model(cfg).init_fn(jax.random.PRNGKey(0))
+    kw = {}
+    if paged:
+        kw.update(page_size=page_size,
+                  pool_pages=slots * (T // page_size))
+    prog = NMTDecodeProgram(cfg, max_src_len=Ts, max_len=T, **kw)
+    pcfg = parallax.Config(serve_config=parallax.ServeConfig(
+        max_batch=slots, max_queue=max_queue))
+
+    fc = fleet_config or FleetConfig(num_replicas=replicas)
+    devs = jax.devices()
+    # split ALL devices across the INITIAL replica count (with 8 CPU
+    # devices and 2 replicas: two 4-device submeshes, no idle devices);
+    # replicas churned/scaled past that wrap onto existing groups —
+    # sharing a submesh also means sharing its compiled executables
+    groups = max(1, int(fc.num_replicas))
+    per = len(devs) // groups
+    meshes = {}
+
+    def make_replica(rid, **serve_kw):
+        if submesh and per >= 1 and groups > 1:
+            g = int(rid) % groups
+            mesh = meshes.get(g)
+            if mesh is None:
+                mesh = meshes[g] = mesh_lib.build_mesh(
+                    devices=devs[g * per:(g + 1) * per],
+                    num_partitions=1)
+        else:
+            mesh = meshes.setdefault(
+                "shared", mesh_lib.build_mesh(num_partitions=1))
+        return ServeSession(program=prog, params=params, config=pcfg,
+                            mesh=mesh, **serve_kw)
+
+    fleet = ServeFleet(make_replica, config=fc, metrics=metrics,
+                       flight=flight, anomaly=anomaly, faults=faults)
+
+    def make_feed(i):
+        r = np.random.default_rng(2000 + i)
+        L = int(r.integers(max(2, Ts // 2), Ts + 1))
+        return {"src": r.integers(3, vocab, (L,)).astype(np.int32)}
+
+    return fleet, make_feed, params, cfg
+
+
 def sweep_decode(levels=(8, 16, 32, 64), requests_per_level=None,
                  result_timeout_s: float = 300.0, **session_kw) -> list:
     """The concurrency sweep: one fresh continuous-decode session per
