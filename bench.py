@@ -50,6 +50,10 @@ PROBE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #               ISSUE 7) — a new sub-block, not a methodology change:
 #               the regression gate SKIPS keys absent on either side,
 #               so no version bump.
+#               r8+: a top-level "ckpt" block (save/restore latency,
+#               checkpoint bytes, async-save step-overhead A/B, train
+#               chaos-harness outcome, ISSUE 9) — again a new block
+#               with gate-side skip semantics, so no version bump.
 BENCH_VERSION = 3
 BASELINE_BASIS = ("sampled-softmax vs full-softmax LM1B at the same "
                   "memory-limited batch; headline measured separately at "
@@ -558,6 +562,31 @@ def worker_main():
             print(f"# decode bench failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
 
+    # Checkpoint cost block (ISSUE 9): save/restore latency, bytes,
+    # and the async-save step-overhead A/B (async critical-path cost
+    # vs the synchronous path, amortized over the save cadence —
+    # tools/bench_ckpt.py, budget <= 2%). The chaos-harness outcome
+    # (tools/check_train_faults.py) rides along so every round proves
+    # SIGKILL-exact-resume / torn-fallback / NaN-rollback still hold.
+    # PARALLAX_BENCH_CKPT=0 skips; check_regression secondary-gates
+    # ckpt.save_ms / ckpt.restore_ms between compatible rounds.
+    ckpt_snap = None
+    if os.environ.get("PARALLAX_BENCH_CKPT", "1") != "0":
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from tools import bench_ckpt
+            ckpt_snap = bench_ckpt.measure()
+            if os.environ.get("PARALLAX_BENCH_CKPT_FAULTS", "1") != "0":
+                from tools import check_train_faults
+                cres = check_train_faults.measure()
+                cviol = check_train_faults.check(cres)
+                ckpt_snap["faults"] = dict(
+                    cres["bench"], ok=not cviol,
+                    violations=cviol[:3] or None)
+        except Exception as e:
+            print(f"# ckpt bench failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
     per_chip = hybrid_wps / n_chips
 
     # Same-round A/B on a bench_version bump (VERDICT r5 item 6): the
@@ -645,6 +674,9 @@ def worker_main():
         # KV-cached vs cache-less decode ratios (the serve-side latency
         # primitive), tracked per round
         "decode": decode_snap,
+        # checkpoint/recovery costs (ISSUE 9): save/restore latency,
+        # bytes, async-vs-sync step-overhead A/B, chaos-harness outcome
+        "ckpt": ckpt_snap,
         # same-round A/B under the previous round's harness params,
         # recorded iff bench_version bumped this round (VERDICT r5
         # item 6); tools/check_regression.py requires it to treat a

@@ -17,7 +17,8 @@ parallel_run) and the `ops` / `models` subpackages.
 from parallax_tpu.common.config import (AnomalyConfig, CheckPointConfig,
                                         CommunicationConfig, Config,
                                         MPIConfig, ParallaxConfig, PSConfig,
-                                        ProfileConfig, ServeConfig)
+                                        ProfileConfig, RecoveryConfig,
+                                        ServeConfig)
 from parallax_tpu.common.lib import parallax_log as log
 from parallax_tpu.core.engine import Model, TrainState
 from parallax_tpu.parallel.partitions import get_partitioner
@@ -33,7 +34,7 @@ __all__ = [
     "get_partitioner", "parallel_run", "shard", "log", "Config",
     "ParallaxConfig", "PSConfig", "MPIConfig", "CommunicationConfig",
     "CheckPointConfig", "ProfileConfig", "ServeConfig", "AnomalyConfig",
-    "Model",
+    "RecoveryConfig", "Model",
     "TrainState", "ParallaxSession", "Fetch", "StepHandle",
     "materialize", "compile", "obs", "ops", "serve", "ServeSession",
 ]

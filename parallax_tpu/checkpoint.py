@@ -1,204 +1,25 @@
-"""Sharded checkpointing with the reference's trigger semantics.
+"""Compatibility shim: the checkpoint subsystem moved to
+``parallax_tpu.ckpt`` (ISSUE 9 — atomic verifiable store, exact
+resume, resharded restore, NaN auto-rollback). Import from there; this
+module keeps the historical names importable:
 
-Reference: CheckPointConfig (config.py:84-99) -> chief-only
-CheckpointSaverHook saving every N steps / secs (lib.py:38-56), restore
-implicit via MonitoredTrainingSession (ps/runner.py:262-272).
+* :class:`parallax_tpu.ckpt.hook.CheckpointHook` — the per-step
+  trigger hook (step/secs cadence, async saves, restore-with-fallback)
+* :func:`parallax_tpu.ckpt.resume.restore_train_state` — eval-flow /
+  resharded restore
 
-TPU-native: Orbax sharded save of the whole TrainState pytree — every host
-writes its own shards and the coordinator commits (no chief bottleneck,
-no full-state gather). Restore reconstructs arrays with their live
-shardings from the in-memory state template.
+Reference lineage (kept for the record): CheckPointConfig
+(config.py:84-99) -> chief-only CheckpointSaverHook saving every N
+steps / secs (lib.py:38-56), restore implicit via
+MonitoredTrainingSession (ps/runner.py:262-272). The TPU-native
+replacement writes per-process shards with checksums and commits a
+manifest last — see ``parallax_tpu/ckpt/store.py``.
 """
 
-from __future__ import annotations
+from parallax_tpu.ckpt.hook import CheckpointHook
+from parallax_tpu.ckpt.resume import restore_train_state
+from parallax_tpu.ckpt.store import (CheckpointCorrupt, CheckpointStore,
+                                     CheckpointTreeMismatch)
 
-import time
-from typing import Optional
-
-import jax
-
-from parallax_tpu.common.config import CheckPointConfig
-from parallax_tpu.common.lib import parallax_log
-
-
-class CheckpointHook:
-    def __init__(self, config: Optional[CheckPointConfig], worker_id: int):
-        self._config = config or CheckPointConfig()
-        self._worker_id = worker_id
-        self._mngr = None
-        self._last_save_time = time.time()
-        if self._config.ckpt_dir:
-            import orbax.checkpoint as ocp
-            import os
-            if (self._config.save_ckpt_steps is None
-                    and self._config.save_ckpt_secs is None):
-                # ckpt_dir without a trigger would silently never save;
-                # default to the reference stack's 600s cadence
-                # (MonitoredTrainingSession default).
-                self._config.save_ckpt_secs = 600.0
-                parallax_log.info(
-                    "ckpt_dir set without save_ckpt_steps/secs; "
-                    "defaulting to save_ckpt_secs=600")
-            # All step/secs gating happens in maybe_save; Orbax's own
-            # interval gate must not second-guess it (it would silently
-            # drop secs-triggered saves), hence save_interval_steps=1 and
-            # force=True on save.
-            opts = ocp.CheckpointManagerOptions(
-                save_interval_steps=1,
-                max_to_keep=None,  # reference keeps everything
-                                   # (max_to_keep=1000000, lib.py:44)
-                enable_async_checkpointing=bool(
-                    getattr(self._config, "async_save", False)))
-            self._mngr = ocp.CheckpointManager(
-                os.path.abspath(self._config.ckpt_dir), options=opts)
-
-    @property
-    def enabled(self) -> bool:
-        return self._mngr is not None
-
-    # Multi-host secs triggers need a collective decision (below); doing
-    # that every step would block the host on the device stream each step,
-    # so the clock is only consulted on this deterministic step cadence.
-    SECS_BROADCAST_EVERY = 10
-
-    def _decide_due(self, step: int) -> bool:
-        """Save-due decision, deterministic across processes.
-
-        Step triggers are inherently agreed (same step everywhere). Secs
-        triggers read the local wall clock, so hosts can disagree — one
-        would enter the Orbax commit barrier while the rest run ahead
-        into the next step's collectives (distributed hang). Process 0
-        decides and broadcasts the single bit, on a throttled cadence so
-        steady-state steps stay free of host-blocking collectives.
-        """
-        cfg = self._config
-        due_steps = bool(cfg.save_ckpt_steps
-                         and step % cfg.save_ckpt_steps == 0)
-        if not cfg.save_ckpt_secs:
-            return due_steps
-        if jax.process_count() == 1:
-            return due_steps or (time.time() - self._last_save_time
-                                 >= cfg.save_ckpt_secs)
-        if step % self.SECS_BROADCAST_EVERY != 0:
-            return due_steps
-        import numpy as np
-        from jax.experimental import multihost_utils
-        due = due_steps or (time.time() - self._last_save_time
-                            >= cfg.save_ckpt_secs)
-        return bool(multihost_utils.broadcast_one_to_all(
-            np.asarray(due, np.int32)))
-
-    def maybe_save(self, step: int, state) -> bool:
-        if not self.enabled:
-            return False
-        if not self._decide_due(step):
-            return False
-        import orbax.checkpoint as ocp
-        self._mngr.save(step, args=ocp.args.StandardSave(state),
-                        force=True)
-        self._last_save_time = time.time()
-        if getattr(self._config, "async_save", False):
-            # async: the commit finishes on a background thread — the
-            # log must not claim durability the disk doesn't have yet
-            parallax_log.info("dispatched checkpoint save at step %d "
-                             "(async commit)", step)
-        else:
-            parallax_log.info("saved checkpoint at step %d", step)
-        return True
-
-    def restore(self, state_template):
-        """Restore the latest checkpoint onto the template's shardings, or
-        None if there is nothing to restore."""
-        if not self.enabled:
-            return None
-        latest = self._mngr.latest_step()
-        if latest is None:
-            return None
-        import orbax.checkpoint as ocp
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-            if hasattr(x, "sharding") else x, state_template)
-        return self._mngr.restore(latest,
-                                  args=ocp.args.StandardRestore(abstract))
-
-    def close(self):
-        if self._mngr is not None:
-            self._mngr.wait_until_finished()
-            self._mngr.close()
-
-
-def restore_train_state(ckpt_dir: str, model, seed: int = 0,
-                        mesh=None, example_batch=None, config=None):
-    """Restore the latest checkpoint into a fresh TrainState template for
-    ``model`` (eval flows: lm1b_eval, cnn_eval). Returns (state, step).
-
-    Every template leaf carries an explicit sharding, so Orbax never
-    falls back to its restore-as-saved heuristic (unsafe across
-    topologies). With ``example_batch`` the engine's sharding plan is
-    rebuilt and the state is restored onto the live training layout
-    (row-sharded tables etc.); otherwise leaves restore replicated over
-    ``mesh`` (default: all local devices) — right for single-host eval.
-    """
-    import os
-
-    import jax
-    import jax.numpy as jnp
-    import orbax.checkpoint as ocp
-    from jax.sharding import NamedSharding, PartitionSpec
-
-    from parallax_tpu.common.config import ParallaxConfig
-    from parallax_tpu.core import mesh as mesh_lib
-    from parallax_tpu.core.engine import Engine, TrainState
-
-    mngr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
-    latest = mngr.latest_step()
-    if latest is None:
-        mngr.close()
-        raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
-
-    if example_batch is not None:
-        cfg = config or ParallaxConfig(search_partitions=False)
-        engine = Engine(model, mesh or mesh_lib.build_mesh(), cfg,
-                        example_batch)
-        template = engine.init_state(seed)
-
-        def as_abstract(x):
-            return jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                        sharding=x.sharding)
-    else:
-        mesh = mesh or mesh_lib.build_mesh()
-        replicated = NamedSharding(mesh, PartitionSpec())
-        params, mstate = model.call_init(jax.random.PRNGKey(seed))
-        template = TrainState(
-            step=jnp.zeros((), jnp.int32), params=params,
-            opt_state=model.optimizer.init(params),
-            rng=jax.random.PRNGKey(seed), model_state=mstate)
-
-        def as_abstract(x):
-            x = jnp.asarray(x)
-            return jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                        sharding=replicated)
-
-    try:
-        abstract = jax.tree.map(as_abstract, template)
-        restored = mngr.restore(latest,
-                                args=ocp.args.StandardRestore(abstract))
-    except (ValueError, TypeError):
-        # sync=False checkpoints carry a pending_grads subtree
-        # (engine.TrainState): params-shaped at staleness=1, or a
-        # [k, ...]-stacked gradient ring at staleness=k. Retry with the
-        # matching async template.
-        k = int(getattr(config, "staleness", 1) or 1)
-
-        def pending_like(p):
-            p = jnp.asarray(p)
-            shape = p.shape if k == 1 else (k,) + p.shape
-            return jnp.zeros(shape, p.dtype)
-
-        template = template.replace(pending_grads=jax.tree.map(
-            pending_like, template.params))
-        abstract = jax.tree.map(as_abstract, template)
-        restored = mngr.restore(latest,
-                                args=ocp.args.StandardRestore(abstract))
-    mngr.close()
-    return restored, latest
+__all__ = ["CheckpointHook", "restore_train_state", "CheckpointStore",
+           "CheckpointCorrupt", "CheckpointTreeMismatch"]

@@ -1,0 +1,45 @@
+"""Preemption-tolerant training: the checkpoint/recovery subsystem.
+
+The reference's recovery story is "restart from the last checkpoint by
+hand" — chief-only saves, no integrity guarantees, no elasticity
+(SURVEY §5.3-5.4). This package gives the *training* plane the same
+deterministic-degradation contract PR 7 gave the serving fleet:
+
+* ``store``    — atomic, verifiable on-disk checkpoints: every process
+  writes its own shards with per-shard checksums, and a manifest is
+  committed LAST (temp+rename), so a crash mid-save is detected at
+  restore time and falls back to the previous complete checkpoint —
+  never a silent wrong-weights resume. Retention/GC replaces the
+  reference's unbounded keep-everything policy.
+* ``snapshot`` — host-side state snapshots from addressable shards
+  (works under donation and on multi-host), shared by the async save
+  path and the NaN-rollback policy.
+* ``hook``     — the per-step trigger hook (``CheckpointHook``): the
+  reference's step/secs cadence, multi-host agreed decisions, async
+  (off-critical-path) saves with a bounded-staleness guard, the
+  exact-resume extras (data cursor, anomaly/health baselines), and a
+  final-save entry point for preemption notices.
+* ``resume``   — ``restore_train_state`` for eval flows and the
+  resharded-restore rules (a checkpoint saved on one partition
+  layout restores onto any other — the store's manifest describes
+  global arrays, not a device layout).
+* ``recovery`` — NaN/divergence auto-rollback: a cheap in-memory
+  last-good snapshot, bounded retries, batch skip, and an optional
+  LR-backoff hook before surrendering with a flight dump.
+
+``parallax_tpu.checkpoint`` remains as a compatibility shim
+re-exporting the public names.
+"""
+
+from parallax_tpu.ckpt.hook import CheckpointHook
+from parallax_tpu.ckpt.recovery import (RecoveryPolicy, RecoverySurrender,
+                                        host_snapshot, restore_snapshot)
+from parallax_tpu.ckpt.resume import restore_train_state
+from parallax_tpu.ckpt.store import (CheckpointCorrupt, CheckpointStore,
+                                     CheckpointTreeMismatch)
+
+__all__ = [
+    "CheckpointHook", "CheckpointStore", "CheckpointCorrupt",
+    "CheckpointTreeMismatch", "RecoveryPolicy", "RecoverySurrender",
+    "restore_train_state", "host_snapshot", "restore_snapshot",
+]

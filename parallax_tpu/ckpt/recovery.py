@@ -1,0 +1,139 @@
+"""NaN/divergence auto-recovery: rollback instead of dying.
+
+PR 5 taught training to *notice* a non-finite loss (the HealthMonitor's
+``on_nonfinite`` hook dumps a flight artifact); the response was still
+"die and page a human". This module closes the loop: the session keeps
+a cheap in-memory last-good snapshot (host copies of the addressable
+shards, ``ckpt/snapshot.py``), and when a step produces a non-finite
+loss or gradient norm it
+
+1. rolls the live state back to that snapshot (bit-identical re-place
+   through the recorded shardings),
+2. SKIPS the offending batch (the next ``run()`` feeds the next batch;
+   the data cursor keeps advancing while the step counter rewinds —
+   the two are checkpointed separately for exactly this reason),
+3. invokes the optional rollback hook (LR backoff: pair with
+   ``optax.inject_hyperparams`` to scale the learning rate down per
+   retry),
+4. and gives up after ``max_retries`` CONSECUTIVE non-finite steps —
+   a persistently poisoned run surrenders with a
+   ``recovery_surrender`` flight dump and raises
+   :class:`RecoverySurrender` instead of looping forever.
+
+Enabling recovery (``RecoveryConfig.enabled``) requires
+``monitor_health`` (auto-enabled by the config) and makes the dispatch
+thread block on the step's ``loss_finite`` scalar — step-granular
+detection costs the async pipeline's overlap; that trade is the
+feature's contract and is documented in the API reference.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.ckpt.snapshot import (HostSnapshot, host_snapshot,
+                                        restore_snapshot)
+
+__all__ = ["RecoveryPolicy", "RecoverySurrender", "host_snapshot",
+           "restore_snapshot"]
+
+
+class RecoverySurrender(RuntimeError):
+    """Auto-recovery exhausted its retry budget: every rollback+skip
+    attempt reproduced a non-finite step. The run is genuinely
+    poisoned (diverged optimizer state, bad weights region, systemic
+    data corruption) and needs a human."""
+
+
+class RecoveryPolicy:
+    """Owns the last-good snapshot and the retry budget."""
+
+    def __init__(self, config, registry=None,
+                 on_rollback: Optional[Callable[[int], None]] = None):
+        self.config = config
+        if registry is None:
+            from parallax_tpu.obs.metrics import MetricsRegistry
+            registry = MetricsRegistry()
+        self._rollbacks = registry.counter("recovery.rollbacks")
+        self._snapshots = registry.counter("recovery.snapshots")
+        self._snapshot_s = registry.histogram(
+            "recovery.snapshot_seconds")
+        self._surrenders = registry.counter("recovery.surrenders")
+        self.on_rollback = on_rollback
+        self._snap: Optional[HostSnapshot] = None
+        # consecutive non-finite steps since the last finite one: the
+        # surrender trigger. Total rollbacks are the counter above.
+        self.consecutive_failures = 0
+        self.total_rollbacks = 0
+
+    @property
+    def snapshot_step(self) -> Optional[int]:
+        return self._snap.step if self._snap is not None else None
+
+    def maybe_snapshot(self, step: int, state, force: bool = False
+                       ) -> bool:
+        """Refresh the last-good snapshot when the cadence is due
+        (``snapshot_every_steps``) or ``force``. Call ONLY with a state
+        known finite — snapshotting a poisoned state would poison the
+        rollback target. Blocks until the state's values are ready
+        (host copy), so the cadence is the cost knob."""
+        every = int(self.config.snapshot_every_steps)
+        if not force and self._snap is not None \
+                and step % every != 0:
+            return False
+        t0 = time.perf_counter()
+        self._snap = host_snapshot(state, step=step)
+        self._snapshots.inc()
+        self._snapshot_s.record(time.perf_counter() - t0)
+        return True
+
+    def note_good_step(self) -> None:
+        """A finite step landed: the retry budget resets (failures must
+        be CONSECUTIVE to surrender)."""
+        self.consecutive_failures = 0
+
+    def rollback(self, step: int, kind: str):
+        """A non-finite ``kind`` ('loss'/'grad') surfaced at ``step``:
+        return the re-placed last-good state (and its step), or raise
+        :class:`RecoverySurrender` when the budget is exhausted.
+        The caller skips the offending batch and continues."""
+        if self._snap is None:
+            raise RecoverySurrender(
+                f"non-finite {kind} at step {step} with no last-good "
+                f"snapshot to roll back to")
+        self.consecutive_failures += 1
+        if self.consecutive_failures > int(self.config.max_retries):
+            self._surrenders.inc()
+            raise RecoverySurrender(
+                f"non-finite {kind} persisted through "
+                f"{self.consecutive_failures - 1} rollback+skip "
+                f"attempt(s) (max_retries="
+                f"{self.config.max_retries}); surrendering at step "
+                f"{step}")
+        self.total_rollbacks += 1
+        self._rollbacks.inc()
+        parallax_log.warning(
+            "recovery: non-finite %s at step %d — rolling back to "
+            "last-good step %d and skipping the batch (attempt %d/%d)",
+            kind, step, self._snap.step, self.consecutive_failures,
+            int(self.config.max_retries))
+        if self.on_rollback is not None:
+            try:
+                self.on_rollback(self.consecutive_failures)
+            except Exception as e:
+                parallax_log.warning("rollback hook failed: %s", e)
+        return self._snap.restore(), self._snap.step
+
+    def stats(self) -> dict:
+        return {
+            "snapshot_step": self.snapshot_step,
+            "snapshot_nbytes": (self._snap.nbytes
+                                if self._snap is not None else 0),
+            "total_rollbacks": self.total_rollbacks,
+            "consecutive_failures": self.consecutive_failures,
+            "max_retries": int(self.config.max_retries),
+            "snapshot_every_steps":
+                int(self.config.snapshot_every_steps),
+        }

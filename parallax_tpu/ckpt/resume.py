@@ -1,0 +1,140 @@
+"""Restore entry points: eval flows and resharded restore.
+
+The store's manifest describes GLOBAL arrays, so restore is inherently
+layout-agnostic: whatever partition count / mesh shape / process count
+the checkpoint was saved from, it restores onto whatever template the
+caller builds. That one property covers the three scenarios ISSUE 9
+names:
+
+* **same-layout resume** — the session's implicit restore
+  (template = the freshly initialized TrainState on the live mesh);
+* **survivor-only / elastic resume** — after losing a host the
+  relaunched (smaller or re-meshed) cluster builds its own template
+  and the global arrays are re-sliced onto it;
+* **train<->serve mesh handoff** — an eval/serve process restores the
+  training checkpoint replicated (or onto its own plan) via
+  :func:`restore_train_state`.
+
+Numerics: values are restored bit-identically; a CONTINUED run on a
+different layout then matches the same-layout continuation only within
+collective-reduction reordering (documented tolerance; see
+docs/parallax_api.md "Checkpointing & recovery").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def restore_train_state(ckpt_dir: str, model, seed: int = 0,
+                        mesh=None, example_batch=None, config=None):
+    """Restore the latest verified checkpoint into a fresh TrainState
+    template for ``model`` (eval flows: lm1b_eval, cnn_eval). Returns
+    ``(state, step)``.
+
+    Every template leaf carries an explicit sharding. With
+    ``example_batch`` the engine's sharding plan is rebuilt and the
+    state restores onto the live training layout (row-sharded tables
+    etc.) — the layout may differ from the one that saved (resharded
+    restore); otherwise leaves restore replicated over ``mesh``
+    (default: all local devices) — right for single-host eval.
+
+    ``sync=False`` checkpoints carry a ``pending_grads`` subtree the
+    fresh template lacks; its exact shapes/dtypes are rebuilt from the
+    manifest (no staleness guess needed — ``config`` is only used for
+    the engine build).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from parallax_tpu.common.config import ParallaxConfig
+    from parallax_tpu.core import mesh as mesh_lib
+    from parallax_tpu.core.engine import Engine, TrainState
+    from parallax_tpu.ckpt.store import CheckpointStore
+
+    store = CheckpointStore(ckpt_dir, max_to_keep=None)
+    latest = store.latest_step()
+    if latest is None:
+        raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+
+    if example_batch is not None:
+        cfg = config or ParallaxConfig(search_partitions=False)
+        engine = Engine(model, mesh or mesh_lib.build_mesh(), cfg,
+                        example_batch)
+        template = engine.init_state(seed)
+        replicated = NamedSharding(engine.mesh, PartitionSpec())
+    else:
+        mesh = mesh or mesh_lib.build_mesh()
+        replicated = NamedSharding(mesh, PartitionSpec())
+        params, mstate = model.call_init(jax.random.PRNGKey(seed))
+        template = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            opt_state=model.optimizer.init(params),
+            rng=jax.random.PRNGKey(seed), model_state=mstate)
+        template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                np.shape(jnp.asarray(x)), jnp.asarray(x).dtype,
+                sharding=replicated), template)
+
+    template = _extend_pending_grads(store, latest, template,
+                                     replicated)
+    out = store.restore_latest(template)
+    if out is None:
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {ckpt_dir} (all torn or "
+            f"corrupt)")
+    state, step, _info = out
+    return state, step
+
+
+def _extend_pending_grads(store, step: int, template, replicated):
+    """When the manifest carries a ``pending_grads`` subtree (a
+    sync=False / staleness-k checkpoint) and the template doesn't,
+    rebuild that subtree's exact shapes from the manifest so the
+    restore template matches the saved tree."""
+    import jax
+
+    if getattr(template, "pending_grads", None) is not None:
+        return template
+    manifest = store.read_manifest(step)
+    if manifest is None:
+        return template
+    prefix = "pending_grads/"
+    sub = {p[len(prefix):]: info
+           for p, info in manifest.get("leaves", {}).items()
+           if p.startswith(prefix)}
+    if not sub:
+        return template
+    from parallax_tpu.ckpt.store import _resolve_dtype
+    tree = _tree_from_paths({
+        p: jax.ShapeDtypeStruct(tuple(info["shape"]),
+                                _resolve_dtype(info["dtype"]),
+                                sharding=replicated)
+        for p, info in sub.items()})
+    return template.replace(pending_grads=tree)
+
+
+def _tree_from_paths(values: dict):
+    """Rebuild a nested dict/list pytree from 'a/b/0/c'-style paths
+    (dict keys; contiguous integer segments become lists)."""
+    root: dict = {}
+    for path, v in values.items():
+        node = root
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+
+    def materialize(node):
+        if not isinstance(node, dict):
+            return node
+        keys = list(node)
+        if keys and all(k.isdigit() for k in keys):
+            idx = sorted(int(k) for k in keys)
+            if idx == list(range(len(idx))):
+                return [materialize(node[str(i)]) for i in idx]
+        return {k: materialize(v) for k, v in node.items()}
+
+    return materialize(root)

@@ -1,0 +1,140 @@
+"""Host-side state snapshots and pytree path naming.
+
+Two consumers share these helpers:
+
+* the async save path (``ckpt/hook.py``): the step must not block on
+  storage, but the engine DONATES the state buffers to the next step —
+  so the save first copies every locally-addressable shard to host (a
+  bounded D2H memcpy, the only critical-path cost), and serialization /
+  commit happen on a background thread against the host copy;
+* the NaN-rollback policy (``ckpt/recovery.py``): the last-good state
+  must survive the donation of every later state, so it lives on host
+  and is re-placed through the recorded shardings on rollback.
+
+Snapshots keep the SHARD structure (index -> host array per leaf), not
+gathered full arrays: on multi-host a sharded leaf is not fully
+addressable, so ``np.asarray(leaf)`` would fail — per-shard copies work
+everywhere and roundtrip bit-identically through
+``jax.make_array_from_callback``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def keystr(key_path) -> str:
+    """'a/b/0/c' name for a tree_flatten_with_path key path — attribute,
+    dict, sequence and flattened-index keys all map to one flat segment
+    (the classify-style naming, extended to non-dict containers)."""
+    parts = []
+    for k in key_path:
+        if hasattr(k, "key"):        # DictKey
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):     # GetAttrKey
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):      # SequenceKey
+            parts.append(str(k.idx))
+        else:                        # FlattenedIndexKey and friends
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def flatten_with_names(tree) -> Tuple[List[Tuple[str, Any]], Any]:
+    """[(path, leaf)] + treedef, with stable classify-style names."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return [(keystr(kp), leaf) for kp, leaf in flat], treedef
+
+
+def index_key(index, shape) -> Tuple[Tuple[int, int], ...]:
+    """Normalize a shard ``index`` (tuple of slices) into a hashable
+    ((start, stop), ...) key; scalar arrays normalize to ()."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def local_shards(leaf) -> List[Tuple[Tuple[Tuple[int, int], ...],
+                                     np.ndarray, int]]:
+    """[(index_key, host_array, replica_id)] for every locally
+    addressable shard of ``leaf``. Plain host values yield one
+    full-extent shard with replica_id 0."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards is None:
+        arr = np.asarray(leaf)
+        return [(index_key((slice(None),) * arr.ndim, arr.shape),
+                 arr, 0)]
+    out = []
+    for s in shards:
+        out.append((index_key(s.index, leaf.shape),
+                    np.asarray(s.data), int(s.replica_id)))
+    return out
+
+
+@dataclasses.dataclass
+class _LeafSnapshot:
+    shape: Tuple[int, ...]
+    dtype: Any
+    sharding: Any                      # live Sharding object or None
+    shards: Dict[Tuple, np.ndarray]    # index_key -> host array
+
+
+@dataclasses.dataclass
+class HostSnapshot:
+    """One state pytree copied to host, shard-structured, with the
+    original shardings recorded so ``restore()`` reproduces the exact
+    device layout (bit-identical values)."""
+
+    step: int
+    treedef: Any
+    leaves: List[_LeafSnapshot]
+    nbytes: int
+
+    def restore(self):
+        """Re-place the snapshot onto the devices it was taken from."""
+        placed = []
+        for leaf in self.leaves:
+            if leaf.sharding is None:
+                # plain host leaf: hand back the numpy copy
+                only = next(iter(leaf.shards.values()))
+                placed.append(only)
+                continue
+            placed.append(jax.make_array_from_callback(
+                tuple(leaf.shape), leaf.sharding,
+                lambda idx, _l=leaf: _l.shards[
+                    index_key(idx, _l.shape)]))
+        return jax.tree_util.tree_unflatten(self.treedef, placed)
+
+
+def host_snapshot(state, step: int = 0) -> HostSnapshot:
+    """Copy ``state`` to host (deduped local shards). Blocks until the
+    copied values are ready — call it on a state you are about to keep,
+    never on one the next dispatched step will donate mid-copy."""
+    flat, treedef = jax.tree_util.tree_flatten(state)
+    leaves = []
+    nbytes = 0
+    for leaf in flat:
+        shards: Dict[Tuple, np.ndarray] = {}
+        for key, arr, _replica in local_shards(leaf):
+            if key not in shards:      # replica copies are identical
+                shards[key] = np.array(arr)  # own the memory
+                nbytes += shards[key].nbytes
+        leaves.append(_LeafSnapshot(
+            shape=tuple(np.shape(leaf)),
+            dtype=getattr(leaf, "dtype", np.asarray(leaf).dtype),
+            sharding=getattr(leaf, "sharding", None),
+            shards=shards))
+    return HostSnapshot(step=int(step), treedef=treedef, leaves=leaves,
+                        nbytes=nbytes)
+
+
+def restore_snapshot(snap: HostSnapshot):
+    """Convenience alias of ``snap.restore()``."""
+    return snap.restore()

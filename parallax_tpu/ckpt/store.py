@@ -1,0 +1,564 @@
+"""Atomic, verifiable, layout-agnostic checkpoint store.
+
+On-disk layout (one directory per step, name = the step number, the
+layout the pre-existing tests and tools glob for)::
+
+    <root>/<step>/
+        shards_<process>.npz    per-process unique shards (uint8 wire)
+        shards_<process>.json   that file's shard metadata + checksums
+        manifest.json           committed LAST (temp+rename): the step
+                                is complete iff this file parses
+
+Guarantees:
+
+* **atomic commit** — every byte of array data and metadata is on disk
+  (written + fsynced) before the manifest is renamed into place; a
+  crash at ANY earlier point leaves a directory without a manifest,
+  which restore treats as torn and skips with a loud log.
+* **verifiable** — each shard records a CRC-32 of its wire bytes in the
+  manifest; restore recomputes and refuses a mismatch
+  (``CheckpointCorrupt``), so a truncated or bit-flipped shard can
+  never silently resume wrong weights. The caller
+  (``restore_latest``) falls back to the previous complete checkpoint.
+* **layout-agnostic** — the manifest describes GLOBAL arrays (shape,
+  dtype, covering shard extents), not a device layout: a checkpoint
+  saved on one partition count / mesh shape restores onto any other
+  (the resharded-restore contract; see ``ckpt/resume.py``).
+* **no chief bottleneck** — every process writes only its own unique
+  shards (``replica_id == 0`` dedupes replicated copies); process 0
+  merges the per-process metadata into the manifest after a barrier.
+  A shared filesystem across hosts is assumed, as with any multi-host
+  checkpointing.
+* **bounded retention** — after each commit the oldest complete
+  checkpoints beyond ``max_to_keep`` are deleted, along with torn
+  directories older than the newest complete one (they can never be
+  restored). ``max_to_keep=None`` keeps everything (the reference's
+  behavior, now an explicit opt-in rather than the silent default).
+
+Wire format: every shard is stored as a flat uint8 view of its bytes
+(dtype recorded in metadata), so non-numpy-native dtypes (bfloat16)
+roundtrip without pickle.
+
+Fault injection (the training chaos harness, tools/check_train_faults):
+``PARALLAX_CKPT_FAULT=torn_manifest`` hard-kills the process after the
+shard files are durable but before the manifest commit — the
+"crash mid-checkpoint-write" scenario; ``_fault_hook`` does the same
+in-process for unit tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.ckpt import snapshot as snap_lib
+
+MANIFEST = "manifest.json"
+FORMAT_VERSION = 1
+FAULT_ENV = "PARALLAX_CKPT_FAULT"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed verification (torn write, truncated shard,
+    checksum mismatch, uncovered extents). Restore falls back to the
+    previous complete checkpoint instead of resuming wrong weights."""
+
+
+class CheckpointTreeMismatch(CheckpointCorrupt):
+    """The restore template's tree (leaf names or shapes) does not
+    match the saved checkpoint's — a CONFIG mismatch (sync flipped,
+    model edited, vocab resized), not disk damage. Falling back to an
+    older checkpoint cannot help (they share the structure), so
+    ``restore_latest`` PROPAGATES this instead of quietly degrading to
+    a fresh start; the old Orbax restore errored here too."""
+
+
+def _fsync_write(path: str, data: bytes) -> None:
+    """Write ``data`` durably via temp+fsync+rename (atomic publish)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _fsync_savez(path: str, arrays: Dict[str, np.ndarray]) -> None:
+    """np.savez straight into the temp FILE (no intermediate in-memory
+    zip — the checkpoint is already ~1x state bytes on the heap during
+    an async save's snapshot; buffering the whole archive would make
+    the peak ~2-3x), fsync, then atomic rename."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _wire(arr: np.ndarray) -> np.ndarray:
+    """Flat uint8 view of the array's bytes (C order)."""
+    return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+
+
+def _unwire(buf: np.ndarray, dtype: str, shape) -> np.ndarray:
+    dt = np.dtype(_resolve_dtype(dtype))
+    return np.frombuffer(buf.tobytes(), dtype=dt).reshape(tuple(shape))
+
+
+def _resolve_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # extension dtypes (bfloat16) resolve through jax.numpy
+        import jax.numpy as jnp
+        return np.dtype(getattr(jnp, name))
+
+
+def _barrier(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
+
+class CheckpointStore:
+    """Owns one checkpoint root directory."""
+
+    def __init__(self, root: str, max_to_keep: Optional[int] = 5,
+                 registry=None):
+        self.root = os.path.abspath(root)
+        self.max_to_keep = max_to_keep
+        if registry is None:
+            from parallax_tpu.obs.metrics import MetricsRegistry
+            registry = MetricsRegistry()
+        self._saved = registry.counter("ckpt.saved")
+        self._save_seconds = registry.histogram("ckpt.save_seconds")
+        self._bytes = registry.gauge("ckpt.bytes")
+        self._gc_deleted = registry.counter("ckpt.gc_deleted")
+        self._torn = registry.counter("ckpt.torn_detected")
+        self._fallbacks = registry.counter("ckpt.restore_fallbacks")
+        # test seam: fn(phase) called at 'after_shards' /
+        # 'before_manifest'; the env knob covers subprocess drivers
+        self._fault_hook: Optional[Callable[[str], None]] = None
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, step: int, state, extras: Optional[dict] = None
+             ) -> str:
+        """Write one complete checkpoint for ``state`` (a pytree of jax
+        or host arrays) and return its directory. Safe against crashes
+        at any point: the checkpoint only exists once the manifest
+        lands. ``extras``: a small JSON-able dict committed inside the
+        manifest (the exact-resume closure: data cursor, detector
+        baselines...)."""
+        t0 = time.perf_counter()
+        step = int(step)
+        d = os.path.join(self.root, str(step))
+        proc = jax.process_index()
+        if proc == 0 and os.path.isdir(d):
+            if not self._is_own_layout(step):
+                # a numeric dir in a different on-disk format (a
+                # pre-upgrade checkpoint): overwriting it would
+                # destroy the prior run's progress — refuse loudly
+                # and make the operator decide
+                raise CheckpointCorrupt(
+                    f"step dir {d} holds an unrecognized checkpoint "
+                    f"layout (saved by a pre-upgrade version?); "
+                    f"refusing to overwrite — migrate or clear it")
+            # clear EVERY prior artifact at this step — a torn
+            # attempt's leftovers, or a committed save from a run
+            # with a different process count whose stale
+            # shards_<p>.* files _merge_manifest would otherwise
+            # merge into the new manifest (same-step re-saves are a
+            # designed-in event: NaN rollback rewinds, fallback
+            # resume retrains). The dir is manifest-less until the
+            # new commit, so a crash in between reads as torn and
+            # falls back — never as a franken-checkpoint.
+            shutil.rmtree(d, ignore_errors=True)
+        if jax.process_count() > 1:
+            # the clear must not race other processes' fresh shard
+            # writes (and nobody may write before it completes)
+            _barrier(f"parallax_ckpt_clear_{step}")
+        os.makedirs(d, exist_ok=True)
+
+        named, _ = snap_lib.flatten_with_names(state)
+        arrays: Dict[str, np.ndarray] = {}
+        meta: Dict[str, Any] = {"process": proc, "leaves": {}}
+        for path, leaf in named:
+            shape = tuple(int(s) for s in np.shape(leaf))
+            dtype = str(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+            shard_rows = []
+            for idx_key, arr, replica in snap_lib.local_shards(leaf):
+                if replica != 0:
+                    continue  # one writer per unique extent, globally
+                key = f"{path}::{'_'.join('%d-%d' % se for se in idx_key)}"
+                wire = _wire(arr)
+                arrays[key] = wire
+                shard_rows.append({
+                    "key": key,
+                    "extent": [list(se) for se in idx_key],
+                    "crc32": zlib.crc32(wire.tobytes()) & 0xFFFFFFFF,
+                    "nbytes": int(wire.nbytes),
+                })
+            meta["leaves"][path] = {
+                "shape": list(shape), "dtype": dtype,
+                "shards": shard_rows,
+            }
+        shard_file = f"shards_{proc}.npz"
+        _fsync_savez(os.path.join(d, shard_file), arrays)
+        meta["file"] = shard_file
+        _fsync_write(os.path.join(d, f"shards_{proc}.json"),
+                     json.dumps(meta).encode())
+        self._fire_fault("after_shards")
+        _barrier(f"parallax_ckpt_shards_{step}")
+        if proc == 0:
+            manifest = self._merge_manifest(d, step, extras)
+            self._fire_fault("before_manifest")
+            # default=str: extras are caller-supplied and may carry np
+            # scalars — stringify rather than lose the whole save
+            _fsync_write(os.path.join(d, MANIFEST),
+                         json.dumps(manifest, indent=1,
+                                    default=str).encode())
+            self.gc()
+        _barrier(f"parallax_ckpt_commit_{step}")
+        self._saved.inc()
+        self._save_seconds.record(time.perf_counter() - t0)
+        self._bytes.set(_dir_bytes(d))
+        return d
+
+    def _fire_fault(self, phase: str) -> None:
+        if self._fault_hook is not None:
+            self._fault_hook(phase)
+        env = os.environ.get(FAULT_ENV, "")
+        if env == "torn_manifest" and phase == "before_manifest":
+            parallax_log.error(
+                "PARALLAX_CKPT_FAULT=torn_manifest: dying before the "
+                "manifest commit (chaos harness)")
+            os._exit(31)
+
+    def _merge_manifest(self, d: str, step: int,
+                        extras: Optional[dict]) -> dict:
+        """Process 0 merges every process's shard metadata (shared FS)
+        into one manifest describing the global arrays."""
+        leaves: Dict[str, Any] = {}
+        for name in sorted(os.listdir(d)):
+            if not (name.startswith("shards_")
+                    and name.endswith(".json")):
+                continue
+            with open(os.path.join(d, name)) as f:
+                meta = json.load(f)
+            for path, info in meta["leaves"].items():
+                entry = leaves.setdefault(path, {
+                    "shape": info["shape"], "dtype": info["dtype"],
+                    "shards": []})
+                for row in info["shards"]:
+                    entry["shards"].append(dict(row,
+                                                file=meta["file"]))
+        return {
+            "format_version": FORMAT_VERSION,
+            "step": int(step),
+            "ts": time.time(),
+            "process_count": jax.process_count(),
+            "extras": extras or {},
+            "leaves": leaves,
+        }
+
+    # -- enumeration -------------------------------------------------------
+
+    def all_steps(self) -> List[int]:
+        """Every step directory, complete or not, ascending."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        return sorted(int(n) for n in names
+                      if n.isdigit()
+                      and os.path.isdir(os.path.join(self.root, n)))
+
+    def complete_steps(self) -> List[int]:
+        """Steps whose manifest parses (committed saves), ascending."""
+        out = []
+        for s in self.all_steps():
+            if self.read_manifest(s) is not None:
+                out.append(s)
+        return out
+
+    def committed_steps(self) -> List[int]:
+        """Steps whose manifest EXISTS, ascending — the cheap
+        (parse-free) completeness test for retention: the manifest is
+        published by atomic rename, so existence == committed. Restore
+        paths still parse (they need the contents anyway)."""
+        return [s for s in self.all_steps()
+                if os.path.exists(os.path.join(self.root, str(s),
+                                               MANIFEST))]
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.complete_steps()
+        return steps[-1] if steps else None
+
+    def read_manifest(self, step: int) -> Optional[dict]:
+        """The step's manifest, or None when missing/unparseable
+        (torn)."""
+        path = os.path.join(self.root, str(int(step)), MANIFEST)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _is_own_layout(self, step: int) -> bool:
+        """True when the step directory holds only THIS format's
+        artifacts (or nothing) — ours to clear/GC. A directory with
+        unrecognized content is most likely a pre-upgrade checkpoint
+        in a different on-disk format (e.g. the old orbax layout
+        shares the numeric-dir convention): it is not restorable by
+        this version, but it must never be deleted — that would
+        destroy the prior run's progress."""
+        d = os.path.join(self.root, str(int(step)))
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return False
+        return all(n.startswith("shards_") or n.startswith(MANIFEST)
+                   for n in names)
+
+    def _warn_foreign(self, steps: List[int]) -> None:
+        if not steps or getattr(self, "_foreign_warned", False):
+            return
+        self._foreign_warned = True
+        parallax_log.error(
+            "checkpoint dir %s holds step dir(s) %s in an "
+            "UNRECOGNIZED layout (saved by a pre-upgrade version?): "
+            "they cannot be restored by this format and will be left "
+            "untouched — migrate or clear them manually",
+            self.root, steps)
+
+    # -- restore -----------------------------------------------------------
+
+    def restore(self, step: int, template, verify: bool = True,
+                manifest: Optional[dict] = None):
+        """Restore checkpoint ``step`` onto ``template``'s structure and
+        shardings. Template leaves may be live jax arrays,
+        ``ShapeDtypeStruct``\\ s carrying a sharding, or plain host
+        arrays (restored as numpy). Raises ``CheckpointCorrupt`` on any
+        integrity failure — the caller decides the fallback.
+        ``manifest``: the already-parsed manifest when the caller has
+        one (restore_latest — manifests carry a row per shard per
+        leaf, so re-parsing per attempt is real I/O)."""
+        if manifest is None:
+            manifest = self.read_manifest(step)
+        if manifest is None:
+            raise CheckpointCorrupt(
+                f"checkpoint {step} under {self.root} has no readable "
+                f"manifest (torn or in-progress save)")
+        named, treedef = snap_lib.flatten_with_names(template)
+        # two-way structure check: a template leaf the manifest lacks
+        # OR a saved leaf the template would silently drop are both a
+        # config mismatch, not disk damage — refuse loudly instead of
+        # resuming with part of the training closure discarded
+        want = {path for path, _ in named}
+        have = set(manifest["leaves"])
+        if want != have:
+            raise CheckpointTreeMismatch(
+                f"checkpoint {step}'s saved tree does not match the "
+                f"restore template: missing from checkpoint "
+                f"{sorted(want - have)[:8]}, absent from template "
+                f"{sorted(have - want)[:8]} — a config/model change, "
+                f"not corruption (sync flipped? model edited?)")
+        files = _ShardFiles(os.path.join(self.root, str(int(step))))
+        placed = []
+        for path, leaf in named:
+            placed.append(self._assemble(
+                path, manifest["leaves"][path], leaf, files, step,
+                verify))
+        return jax.tree_util.tree_unflatten(treedef, placed)
+
+    def restore_extras(self, step: int) -> dict:
+        m = self.read_manifest(step)
+        return (m or {}).get("extras", {}) or {}
+
+    def restore_latest(self, template, verify: bool = True):
+        """Restore the newest checkpoint that passes verification,
+        falling back (loudly) across torn/corrupt ones. Returns
+        ``(state, step, info)`` or ``None`` when nothing restorable
+        exists. ``info`` records the fallback trail for forensics."""
+        skipped: List[dict] = []
+        # ONE manifest parse per step dir: the torn scan, the
+        # completeness test and the restore attempt all read from here
+        manifests = {s: self.read_manifest(s) for s in self.all_steps()}
+        foreign = [s for s, m in manifests.items()
+                   if m is None and not self._is_own_layout(s)]
+        self._warn_foreign(foreign)
+        torn = [s for s, m in manifests.items()
+                if m is None and s not in foreign]
+        for s in torn:
+            self._torn.inc()
+            parallax_log.warning(
+                "checkpoint %d under %s is TORN (no committed "
+                "manifest — a crash mid-save); it will not be "
+                "restored", s, self.root)
+        complete = [s for s, m in manifests.items() if m is not None]
+        for s in sorted(complete, reverse=True):
+            try:
+                state = self.restore(s, template, verify=verify,
+                                     manifest=manifests[s])
+                info = {"step": s, "torn_steps": torn,
+                        "fallbacks": skipped}
+                if skipped or torn:
+                    self._fallbacks.inc()
+                    parallax_log.warning(
+                        "checkpoint restore FELL BACK to step %d "
+                        "(torn: %s, corrupt: %s) — up to "
+                        "`save cadence` steps of work re-run from "
+                        "there", s, torn,
+                        [k["step"] for k in skipped])
+                return state, s, info
+            except CheckpointTreeMismatch:
+                # structural mismatch: every older checkpoint shares
+                # the structure, so falling back would only end in a
+                # silent fresh start — surface it to the caller
+                raise
+            except CheckpointCorrupt as e:
+                self._torn.inc()
+                parallax_log.error(
+                    "checkpoint %d FAILED verification (%s); falling "
+                    "back to the previous complete checkpoint", s, e)
+                skipped.append({"step": s, "error": str(e)})
+        return None
+
+    def _assemble(self, path: str, entry: dict, leaf,
+                  files: "_ShardFiles", step: int, verify: bool):
+        shape = tuple(entry["shape"])
+        want_shape = tuple(int(s) for s in np.shape(leaf))
+        if shape != want_shape:
+            raise CheckpointTreeMismatch(
+                f"leaf {path!r} of checkpoint {step} has shape "
+                f"{shape}, template wants {want_shape} — a "
+                f"config/model change, not corruption")
+        want_dtype = np.dtype(getattr(leaf, "dtype",
+                                      np.asarray(leaf).dtype))
+        saved_dtype = np.dtype(_resolve_dtype(entry["dtype"]))
+        if saved_dtype != want_dtype:
+            # a silent dtype swap would hand the AOT step arrays that
+            # no longer match its compiled signature — a confusing
+            # donation/signature error far from the cause (the serving
+            # plane's swap_params validates dtype for the same reason)
+            raise CheckpointTreeMismatch(
+                f"leaf {path!r} of checkpoint {step} has dtype "
+                f"{saved_dtype}, template wants {want_dtype} — a "
+                f"config/model change, not corruption")
+        full = np.empty(shape, dtype=saved_dtype)
+        covered = 0
+        for row in entry["shards"]:
+            try:
+                wire = files.get(row["file"], row["key"])
+            except CheckpointCorrupt:
+                raise
+            except Exception as e:
+                # a truncated/garbled shard file surfaces as whatever
+                # np.load's zip layer throws (BadZipFile, OSError,
+                # KeyError...) — all of them mean the same thing here
+                raise CheckpointCorrupt(
+                    f"leaf {path!r} shard {row['key']!r} of checkpoint "
+                    f"{step} is unreadable: {type(e).__name__}: {e}")
+            if verify:
+                crc = zlib.crc32(wire.tobytes()) & 0xFFFFFFFF
+                if crc != row["crc32"] or wire.nbytes != row["nbytes"]:
+                    raise CheckpointCorrupt(
+                        f"leaf {path!r} shard {row['key']!r} of "
+                        f"checkpoint {step} failed its checksum "
+                        f"({wire.nbytes} bytes, crc {crc:#x} != "
+                        f"recorded {row['crc32']:#x})")
+            extent = tuple((int(a), int(b)) for a, b in row["extent"])
+            piece = _unwire(wire, entry["dtype"],
+                            [b - a for a, b in extent])
+            full[tuple(slice(a, b) for a, b in extent)] = piece
+            covered += piece.size
+        if covered != full.size:
+            raise CheckpointCorrupt(
+                f"leaf {path!r} of checkpoint {step}: shards cover "
+                f"{covered} of {full.size} elements (incomplete save)")
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding,
+                                            "devices_indices_map"):
+            return jax.make_array_from_callback(
+                shape, sharding, lambda idx, _f=full: _f[idx])
+        return full
+
+    # -- retention ---------------------------------------------------------
+
+    def gc(self) -> int:
+        """Apply the retention policy (process 0 only): keep the newest
+        ``max_to_keep`` COMPLETE checkpoints, drop older ones, and drop
+        torn directories older than the newest complete step (they can
+        never be restored; a newer torn dir may be an in-progress
+        save). Returns directories deleted."""
+        if jax.process_index() != 0:
+            return 0
+        # parse-free: gc() runs on EVERY cadence save, and each
+        # manifest carries a row per shard per leaf — existence of the
+        # atomically-renamed manifest is the completeness test here
+        complete = self.committed_steps()
+        doomed = []
+        if self.max_to_keep is not None and \
+                len(complete) > int(self.max_to_keep):
+            doomed += complete[:len(complete) - int(self.max_to_keep)]
+        if complete:
+            # only OUR torn leftovers: a manifest-less dir with
+            # unrecognized content is a pre-upgrade checkpoint —
+            # unrestorable here, but never ours to delete
+            stale = [s for s in self.all_steps()
+                     if s < complete[-1] and s not in complete]
+            self._warn_foreign(
+                [s for s in stale if not self._is_own_layout(s)])
+            doomed += [s for s in stale if self._is_own_layout(s)]
+        for s in sorted(set(doomed)):
+            shutil.rmtree(os.path.join(self.root, str(s)),
+                          ignore_errors=True)
+            self._gc_deleted.inc()
+        if doomed:
+            parallax_log.info(
+                "checkpoint GC removed %d dir(s) under %s (keep=%s)",
+                len(set(doomed)), self.root, self.max_to_keep)
+        return len(set(doomed))
+
+    def total_bytes(self) -> int:
+        return sum(_dir_bytes(os.path.join(self.root, str(s)))
+                   for s in self.all_steps())
+
+
+class _ShardFiles:
+    """Lazy npz readers for one checkpoint directory."""
+
+    def __init__(self, d: str):
+        self._d = d
+        self._open: Dict[str, Any] = {}
+
+    def get(self, fname: str, key: str) -> np.ndarray:
+        z = self._open.get(fname)
+        if z is None:
+            z = self._open[fname] = np.load(
+                os.path.join(self._d, fname), allow_pickle=False)
+        return z[key]
+
+
+def _dir_bytes(d: str) -> int:
+    total = 0
+    try:
+        for name in os.listdir(d):
+            try:
+                total += os.path.getsize(os.path.join(d, name))
+            except OSError:
+                pass
+    except OSError:
+        pass
+    return total

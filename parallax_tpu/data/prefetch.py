@@ -35,6 +35,34 @@ from typing import Any, Callable, Iterable, Iterator, Optional
 from parallax_tpu.obs import trace
 
 
+def skip_items(source: Iterable, n: int) -> Iterator:
+    """Fast-forward ``n`` items of ``source`` — the checkpoint
+    data-cursor replay/skip protocol (ISSUE 9): an exactly-resumed run
+    rebuilds its input stream from the epoch start and SKIPS the
+    ``session.data_cursor`` batches the interrupted run already
+    consumed, so batch *t* of the resumed run is bit-identical to
+    batch *t* of the uninterrupted one. Skipping pays iteration cost
+    only — no feed conversion, no H2D placement (those happen
+    downstream of this adapter).
+
+    Raises ``ValueError`` if the stream ends inside the skip window (a
+    cursor pointing past the data is a wiring bug, not an exhausted
+    epoch — resuming there would silently train on nothing).
+    """
+    it = iter(source)
+    n = int(n)
+    with trace.span("prefetch.skip", items=n):
+        for i in range(n):
+            try:
+                next(it)
+            except StopIteration:
+                raise ValueError(
+                    f"data stream ended after {i} item(s) while "
+                    f"skipping to cursor {n}; the resume cursor "
+                    f"points past the stream") from None
+    return it
+
+
 class _End:
     """Queue sentinel: normal exhaustion of the source iterator."""
 
@@ -51,11 +79,17 @@ class Prefetcher:
     ``depth`` items ahead on a background thread."""
 
     def __init__(self, source: Iterable, place_fn: Optional[Callable] = None,
-                 depth: int = 2, name: str = "parallax-prefetch"):
+                 depth: int = 2, name: str = "parallax-prefetch",
+                 skip: int = 0):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.depth = depth
-        self._source = iter(source)
+        # resume protocol: fast-forward past already-consumed items
+        # BEFORE the worker starts placing (skip_items raises on a
+        # cursor past the stream — synchronously, at construction,
+        # where the caller can still see its own stack)
+        self._source = (skip_items(source, skip) if skip
+                        else iter(source))
         self._place_fn = place_fn
         # depth slots of *finished* work; the item the worker is busy
         # placing makes the effective pipeline depth+1 deep, matching

@@ -172,6 +172,26 @@ def _run_cluster_once(hosts: Sequence[HostInfo],
                 f.close()
     chief = procs[-1][1]
     user_interrupt = False
+    # Preemption notice (ISSUE 9): a SIGTERM to the master (the pod
+    # eviction path) is FORWARDED to every local worker before
+    # teardown, so each session's preemption handler gets to dump its
+    # flight post-mortem and attempt a final checkpoint. ssh does not
+    # forward signals, so remote workers rely on the teardown pidfile
+    # kill (INT first) below — best-effort by nature. The notice is
+    # treated like a user interrupt: an eviction must not trigger an
+    # elastic restart into a machine that is going away.
+    def _forward_term(signum, frame):
+        for _mid, p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        raise KeyboardInterrupt
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _forward_term)
+    except ValueError:  # not the main thread: no forwarding possible
+        prev_term = None
     try:
         # Wait on the chief but abort the whole cluster as soon as ANY
         # worker dies (the reference master only watched the chief,
@@ -196,6 +216,8 @@ def _run_cluster_once(hosts: Sequence[HostInfo],
         rc = 130
         user_interrupt = True
     finally:
+        if prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
         # Clean exits need no kill: the spawn wrapper already removed
         # their pidfile and there is no process left. Only workers whose
         # ssh client is still live, or that exited non-zero (client died

@@ -87,6 +87,30 @@ class _SignalDetector:
     # rolling baseline doesn't need)
     REFRESH = 8
 
+    def snapshot(self) -> dict:
+        """JSON-able baseline state (checkpoint extras): the rolling
+        window, observation counters and cooldown — everything a
+        resumed run needs so detectors re-arm exactly where the
+        interrupted run left them instead of re-learning (and possibly
+        firing on) warmup noise."""
+        return {
+            "window": [float(v) for v in self.window],
+            "recent": [float(v) for v in self._recent],
+            "n": self._n,
+            "cooldown_until": self._cooldown_until,
+        }
+
+    def restore(self, snap: dict) -> None:
+        """Inverse of :meth:`snapshot`; tolerates truncated dicts."""
+        self.window.clear()
+        self.window.extend(float(v) for v in snap.get("window", []))
+        self._recent.clear()
+        self._recent.extend(float(v) for v in snap.get("recent", []))
+        self._recent_sum = float(sum(self._recent))
+        self._n = int(snap.get("n", len(self.window)))
+        self._cooldown_until = int(snap.get("cooldown_until", 0))
+        self._stale = 0  # recompute the cached median/MAD on next use
+
     def rebaseline(self) -> None:
         """Forget the baseline and hold fire for ``cooldown`` further
         observations — the new level becomes the new normal. Called on
@@ -228,6 +252,30 @@ class AnomalyMonitor:
             parallax_log.info(
                 "anomaly: rebaselined for deliberate change: %s",
                 reason)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-signal baseline snapshots (exact-resume checkpoint
+        extras; see _SignalDetector.snapshot)."""
+        with self._lock:
+            return {name: det.snapshot()
+                    for name, det in self._detectors.items()}
+
+    def restore_snapshot(self, snap: Optional[Dict[str, dict]]) -> None:
+        """Recreate detectors from checkpointed baselines. Unknown or
+        malformed entries are skipped — resuming must never fail on
+        forensics state."""
+        if not isinstance(snap, dict):
+            return
+        with self._lock:
+            for name, det_snap in snap.items():
+                try:
+                    det = self._detectors.get(name)
+                    if det is None:
+                        det = self._detectors[name] = _SignalDetector(
+                            self.config)
+                    det.restore(det_snap)
+                except Exception:
+                    continue
 
     def events(self) -> List[dict]:
         """JSON-ready copies of the recent events (flight dumps)."""

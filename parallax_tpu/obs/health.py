@@ -235,6 +235,29 @@ class HealthMonitor:
             except Exception:
                 pass
 
+    def snapshot(self) -> Dict:
+        """JSON-able baseline (checkpoint extras): the lifetime
+        finiteness accounting a resumed run should carry forward so
+        ``healthy``/``first_nonfinite_step`` describe the RUN, not the
+        process. The pending device values are not drained — only
+        already-consumed history is checkpointable."""
+        return {
+            "n_observed": self._n_observed,
+            "n_nonfinite_loss": self._n_nonfinite_loss,
+            "n_nonfinite_grad": self._n_nonfinite_grad,
+            "first_nonfinite_step": self.first_nonfinite_step,
+        }
+
+    def restore_snapshot(self, snap: Optional[Dict]) -> None:
+        if not isinstance(snap, dict):
+            return
+        self._n_observed = int(snap.get("n_observed", 0))
+        self._n_nonfinite_loss = int(snap.get("n_nonfinite_loss", 0))
+        self._n_nonfinite_grad = int(snap.get("n_nonfinite_grad", 0))
+        first = snap.get("first_nonfinite_step")
+        self.first_nonfinite_step = (int(first) if first is not None
+                                     else None)
+
     def recent_readings(self):
         """JSON-ready copies of the readings ring (flight dumps)."""
         with self._readings_lock:

@@ -52,7 +52,8 @@ from parallax_tpu.common.lib import configure_logging, parallax_log
 from parallax_tpu.compile import bucketing as bucketing_lib, \
     cache as compile_cache
 from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
-from parallax_tpu.checkpoint import CheckpointHook
+from parallax_tpu.ckpt import CheckpointHook, RecoveryPolicy, \
+    RecoverySurrender
 from parallax_tpu.obs import aggregate as aggregate_lib, trace
 from parallax_tpu.obs.anomaly import AnomalyMonitor
 from parallax_tpu.obs.flightrec import FlightRecorder
@@ -258,12 +259,18 @@ class ParallaxSession:
         self._build_lock = threading.Lock()
         self._search = partition_search
         self._step_times: List[float] = []
-        self._ckpt = CheckpointHook(config.ckpt_config, worker_id)
         self._profile = ProfileHook(config.profile_config, worker_id)
         self._last_outputs: Dict[str, Any] = {}
         # Host-side mirror of state.step: reading the device value every
         # run() would block on the previous step and kill async dispatch.
         self._host_step = 0
+        # Data-pipeline cursor: batches CONSUMED, checkpointed in the
+        # manifest extras and deliberately separate from _host_step —
+        # a NaN rollback rewinds the step counter but keeps consuming
+        # forward (the offending batch is skipped, not replayed), so
+        # only this counter tells a resumed run where its input stream
+        # stands (run_iter(skip=...) / data.prefetch.skip_items).
+        self._data_cursor = 0
         # -- observability (obs/): one registry for the whole runtime --
         configure_logging(config.log_level, config.log_json)
         # grow-only: the collector is process-global, and a later
@@ -287,6 +294,19 @@ class ParallaxSession:
                                       on_event=self._on_anomaly)
         self._last_host_report: Optional[Dict] = None
         self._flops_resolved = False
+        # -- checkpoint/recovery subsystem (ckpt/) ----------------------
+        # the hook shares the session registry so ckpt.* metrics land
+        # in the same snapshot as pipeline.*/engine.*
+        self._ckpt = CheckpointHook(config.ckpt_config, worker_id,
+                                    registry=self.metrics)
+        self._recovery = (RecoveryPolicy(
+            config.recovery_config, self.metrics,
+            on_rollback=self._fire_rollback_hooks)
+            if config.recovery_config.enabled else None)
+        self._rollback_hooks: List[Any] = []
+        self._sigterm_installed = False
+        self._prev_sigterm = None
+        self._session_closed = False
         self.flight = FlightRecorder(
             flight_dir=config.flight_dir, registry=self.metrics,
             providers={
@@ -299,6 +319,10 @@ class ParallaxSession:
                 "metrics": self.metrics_snapshot,
                 "device_memory": device_memory_stats,
                 "config": self._config_summary,
+                "ckpt": self._ckpt.stats,
+                "recovery": (self._recovery.stats
+                             if self._recovery is not None
+                             else lambda: None),
             })
         self.health = (HealthMonitor(
             self.metrics, on_nonfinite=self._on_nonfinite,
@@ -323,6 +347,7 @@ class ParallaxSession:
         if config.compilation_cache_dir:
             compile_cache.enable_persistent_cache(
                 config.compilation_cache_dir)
+        self._install_preemption_handler()
 
     # -- lazy build (needs the first batch to know shapes) ----------------
 
@@ -343,9 +368,41 @@ class ParallaxSession:
             restored = self._ckpt.restore(self._state)
             if restored is not None:
                 self._state = restored
-                parallax_log.info("restored checkpoint at step %d",
-                                  int(self._state.step))
-            self._host_step = int(self._state.step)
+                self._apply_restored_extras()
+            else:
+                self._host_step = int(self._state.step)
+                self._data_cursor = self._host_step
+            if self._recovery is not None:
+                # seed the last-good snapshot from the initial (or
+                # restored) state so a NaN on the very first steps
+                # already has a rollback target
+                self._recovery.maybe_snapshot(self._host_step,
+                                              self._state, force=True)
+
+    def _apply_restored_extras(self) -> None:
+        """Re-seat the full training closure from the manifest extras:
+        the exact-resume contract is (TrainState) + (data cursor) +
+        (detector baselines) — the state alone replays the wrong
+        batches and re-arms the detectors on warmup noise."""
+        self._host_step = int(self._state.step)
+        extras = self._ckpt.restored_extras
+        info = self._ckpt.last_restore_info or {}
+        self._data_cursor = int(extras.get("data_cursor",
+                                           self._host_step))
+        self.anomaly.restore_snapshot(extras.get("anomaly"))
+        if self.health is not None:
+            self.health.restore_snapshot(extras.get("health"))
+        parallax_log.info(
+            "restored checkpoint at step %d (data cursor %d)",
+            self._host_step, self._data_cursor)
+        if info.get("fallbacks") or info.get("torn_steps"):
+            # a torn/corrupt newest checkpoint was skipped: loud in the
+            # log (store.py) AND a post-mortem artifact for the fleet
+            self.flight.trigger("ckpt_torn", dict(info))
+        self.flight.trigger(
+            "resume", {"step": self._host_step,
+                       "data_cursor": self._data_cursor,
+                       "restore": dict(info)})
 
     def _build_engine(self, example_batch, num_partitions):
         # Bucket the example up front (no-op without shape_buckets):
@@ -466,7 +523,8 @@ class ParallaxSession:
 
     def run_iter(self, batches: Iterable[Dict[str, Any]],
                  fetches: Union[None, str, Sequence[str]] = None,
-                 placed: bool = False):
+                 placed: bool = False,
+                 skip: Union[int, str] = 0):
         """Pipelined training loop: yields one ``run()`` result per feed
         dict from ``batches``, with feed conversion, ``feed_transforms``
         and host→device placement for batch *t+1* running on a bounded
@@ -488,6 +546,16 @@ class ParallaxSession:
         an external pipeline, e.g. straight off the native token
         loader's thread).
 
+        ``skip`` fast-forwards that many items of ``batches`` before
+        the first step — the checkpoint resume protocol: rebuild the
+        SAME stream from its start and pass
+        ``skip=session.data_cursor`` (or the literal ``"auto"``, which
+        reads the restored cursor after ``prepare()``); the resumed
+        run's batches are then bit-identical to the uninterrupted
+        run's. Skipping pays only iteration cost
+        (``data.prefetch.skip_items`` — no conversion, no H2D) and
+        raises if the stream ends inside the skip window.
+
         While the partition auto-search is live the loop stays
         sequential (a replan rebuilds the mesh, which would invalidate
         in-flight placed batches) and upgrades to prefetching the step
@@ -498,6 +566,17 @@ class ParallaxSession:
         # validate placed=True misuse HERE, not at the first next(): a
         # generator body only runs on iteration, which can be far from
         # the offending call site
+        if skip == "auto":
+            if self._engine is None:
+                # the cursor is only known AFTER the checkpoint
+                # restore; resolving it against a not-yet-built session
+                # would silently skip 0 and retrain the consumed prefix
+                raise ValueError(
+                    "run_iter(skip='auto') before the engine exists: "
+                    "the restored data cursor is only known after the "
+                    "checkpoint restore — call prepare(example_feed) "
+                    "first (or pass an explicit skip count)")
+            skip = self._data_cursor
         if placed and self._search is not None:
             # a replan would rebuild the mesh under batches the
             # external pipeline already placed for the old one
@@ -506,7 +585,13 @@ class ParallaxSession:
                 "partition auto-search is live: a replan would "
                 "invalidate already-placed batches. Finish the "
                 "search first (or disable search_partitions).")
-        return self._run_iter_gen(iter(batches), fetches, placed)
+        it = iter(batches)
+        if int(skip):
+            from parallax_tpu.data.prefetch import skip_items
+            # synchronous, before the generator: a bad cursor raises
+            # at the call site, not at the first next()
+            it = skip_items(it, int(skip))
+        return self._run_iter_gen(it, fetches, placed)
 
     def _next_timed(self, it):
         """``next(it)`` with the wait attributed as the step's
@@ -654,6 +739,13 @@ class ParallaxSession:
         self._last_outputs = outputs
         new_step = step + 1
         self._host_step = new_step
+        self._data_cursor += 1
+        if self._recovery is not None:
+            # step-granular NaN detection (blocks on this step's
+            # in-graph health scalars — the documented recovery trade):
+            # a non-finite step rolls the state back to the last-good
+            # snapshot and the offending batch is skipped
+            self._maybe_recover(step, outputs)
         if self.health is not None:
             # lazy: only already-transferred values are read, so the
             # dispatch thread never blocks on monitoring. `step` (the
@@ -663,7 +755,8 @@ class ParallaxSession:
             self.health.observe(step, outputs.get("loss_finite"),
                                 outputs.get("grad_norm"),
                                 loss=outputs.get("loss"))
-        if self._ckpt.maybe_save(new_step, self._state):
+        if self._ckpt.maybe_save(self._host_step, self._state,
+                                 extras_fn=self._ckpt_extras):
             self._warn_sparse_overflow("checkpoint")
         if self._search is not None:
             self._record_search_time(dt)
@@ -748,6 +841,185 @@ class ParallaxSession:
             self.anomaly.observe("loss", step, float(loss))
         if grad_norm is not None and np.isfinite(grad_norm):
             self.anomaly.observe("grad_norm", step, float(grad_norm))
+
+    # -- checkpoint/recovery (ckpt/) --------------------------------------
+
+    @property
+    def data_cursor(self) -> int:
+        """Batches consumed so far (including any a NaN rollback
+        skipped) — the input-stream position the checkpoint commits.
+        After a restore, skip this many items of the rebuilt stream
+        (``run_iter(..., skip=sess.data_cursor)`` or
+        ``data.prefetch.skip_items``) for bit-identical resumption."""
+        return self._data_cursor
+
+    def _ckpt_extras(self) -> Dict[str, Any]:
+        """The exact-resume closure beyond the TrainState, committed
+        inside the checkpoint manifest."""
+        return {
+            "data_cursor": self._data_cursor,
+            "host_step": self._host_step,
+            "anomaly": self.anomaly.snapshot(),
+            "health": (self.health.snapshot()
+                       if self.health is not None else None),
+            "recovery": (self._recovery.stats()
+                         if self._recovery is not None else None),
+        }
+
+    def set_rollback_hook(self, fn) -> None:
+        """Register ``fn(consecutive_retries)`` to run on every NaN
+        rollback — the LR-backoff seam: pair with
+        ``optax.inject_hyperparams`` and shrink the learning rate per
+        retry so the retried region re-enters a stable regime."""
+        self._rollback_hooks.append(fn)
+
+    def _fire_rollback_hooks(self, retries: int) -> None:
+        for fn in self._rollback_hooks:
+            try:
+                fn(retries)
+            except Exception as e:
+                parallax_log.warning("rollback hook failed: %s", e)
+
+    def _maybe_recover(self, step: int, outputs) -> bool:
+        """Inspect this step's in-graph health scalars; on a non-finite
+        loss/grad roll back to the last-good snapshot (batch skipped —
+        the data cursor keeps advancing). Raises RecoverySurrender
+        after ``max_retries`` consecutive failures. Returns True when a
+        rollback happened."""
+        lf = outputs.get("loss_finite")
+        gn = outputs.get("grad_norm")
+        kind = None
+        if lf is not None and not bool(np.asarray(lf)):
+            kind = "loss"
+        elif gn is not None and not np.isfinite(float(np.asarray(gn))):
+            kind = "grad"
+        if kind is None:
+            # a finite step: refresh the last-good snapshot on cadence
+            # and reset the consecutive-failure budget
+            self._recovery.note_good_step()
+            self._recovery.maybe_snapshot(self._host_step, self._state)
+            return False
+        self.flight.trigger(
+            "nonfinite_rollback",
+            {"step": step, "kind": kind,
+             "snapshot_step": self._recovery.snapshot_step,
+             "data_cursor": self._data_cursor})
+        try:
+            state, snap_step = self._recovery.rollback(step, kind)
+        except RecoverySurrender as e:
+            self.flight.trigger(
+                "recovery_surrender",
+                {"step": step, "kind": kind, "error": str(e),
+                 "rollbacks": self._recovery.total_rollbacks})
+            raise
+        self._state = state
+        self._host_step = snap_step
+        return True
+
+    def on_preemption(self, signum: Optional[int] = None) -> None:
+        """The eviction path (SIGTERM by default): leave a
+        ``preemption`` post-mortem and attempt ONE final synchronous
+        checkpoint of the current state. Best-effort end to end — an
+        evicted worker must never die harder because its last-gasp
+        forensics failed."""
+        if self._session_closed:
+            # a closed session's handler can survive inside a newer
+            # session's chain; it must pass the signal through without
+            # dumping/saving stale state
+            return
+        try:
+            self.flight.trigger(
+                "preemption",
+                {"signal": signum, "step": self._host_step,
+                 "data_cursor": self._data_cursor})
+        except Exception:
+            pass
+        if self._ckpt.enabled and self._state is not None:
+            self._ckpt.save_now(self._host_step, self._state,
+                                extras=self._ckpt_extras(),
+                                reason="preemption")
+
+    def _install_preemption_handler(self) -> None:
+        """SIGTERM -> on_preemption, then the previous disposition.
+        Installed only when something would be saved (flight_dir or
+        ckpt_dir) and only from the main thread (the signal module's
+        own restriction)."""
+        import signal
+        if not self._config.handle_preemption:
+            return
+        if not (self._config.flight_dir or self._ckpt.enabled):
+            return
+        if threading.current_thread() is not threading.main_thread():
+            return
+        try:
+            # keep the EXACT installed object: bound-method access
+            # creates a fresh object each time, and uninstall must be
+            # able to ask "is the live handler still mine?"
+            self._sigterm_handler = self._handle_preemption
+            self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                               self._sigterm_handler)
+            self._sigterm_installed = True
+        except (ValueError, OSError):
+            self._sigterm_installed = False
+
+    def _handle_preemption(self, signum, frame) -> None:
+        import signal
+        # The handler interrupts the main thread at an arbitrary
+        # bytecode — possibly INSIDE a non-reentrant critical section
+        # (anomaly.observe holds AnomalyMonitor._lock every step).
+        # Doing the dump/save work inline could then deadlock on a
+        # lock this very thread holds, hanging the process through the
+        # whole eviction grace — strictly worse than dying promptly.
+        # So the work runs on a helper thread with a bounded join: in
+        # the common case (signal lands in compute/sleep, locks free)
+        # it completes fully; in the pathological case we give up
+        # after the timeout and terminate — a mid-write save is left
+        # torn, which restore detects and falls back from by design.
+        t = threading.Thread(target=self.on_preemption,
+                             args=(signum,),
+                             name="parallax-preemption", daemon=True)
+        t.start()
+        t.join(timeout=30.0)
+        if t.is_alive():
+            parallax_log.error(
+                "preemption dump/save did not finish within 30s "
+                "(wedged on state the interrupted thread holds?); "
+                "terminating without it")
+        prev = self._prev_sigterm
+        if callable(prev):
+            prev(signum, frame)
+        elif prev is signal.SIG_IGN:
+            # the application had deliberately ignored SIGTERM; the
+            # session may add its post-mortem/save on top but must not
+            # convert an ignored signal into process death
+            return
+        else:
+            # SIG_DFL (or an unknowable C-level disposition): restore
+            # the default and re-deliver, so the process terminates
+            # with the standard SIGTERM status the launcher/pod
+            # runtime expects
+            signal.signal(signum, signal.SIG_DFL)
+            import os as _os
+            _os.kill(_os.getpid(), signum)
+
+    def _uninstall_preemption_handler(self) -> None:
+        if not self._sigterm_installed:
+            return
+        import signal
+        try:
+            # only restore if the live handler is still OURS: with
+            # overlapping session lifetimes, closing an older session
+            # must neither strip a newer session's handler nor
+            # reinstall a closed session's previous chain
+            if signal.getsignal(signal.SIGTERM) \
+                    is self._sigterm_handler:
+                signal.signal(signal.SIGTERM,
+                              self._prev_sigterm
+                              if self._prev_sigterm is not None
+                              else signal.SIG_DFL)
+        except (ValueError, OSError, TypeError):
+            pass
+        self._sigterm_installed = False
 
     def step_flops(self, cheap_only: bool = True) -> Optional[float]:
         """XLA cost-analysis FLOPs of one compiled step, or None.
@@ -1075,11 +1347,13 @@ class ParallaxSession:
         # Each teardown step is isolated: a failure in one (a poisoned
         # device buffer surfacing in the overflow read or the health
         # drain, a failed async checkpoint commit raising from the
-        # orbax close) must not skip the rest — the sink thread would
+        # async-commit join) must not skip the rest — the sink thread would
         # run forever, an in-flight profiler trace would record
         # forever, the configured chrome trace would never land, and
         # engine.close() restores process-global jax settings later
         # sessions depend on.
+        self._session_closed = True
+        self._uninstall_preemption_handler()
         if self._prefetcher is not None:
             self._prefetcher.close()
             self._prefetcher = None

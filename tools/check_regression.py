@@ -199,6 +199,12 @@ SECONDARY_GATES = (
     # rotating weight hot-swap — both must not quietly regress
     ("serve.fleet.failover_recovery_ms", False),
     ("serve.fleet.hotswap_blackout_ms", False),
+    # checkpoint costs (ISSUE 9, tools/bench_ckpt): a save that gets
+    # slower silently erodes the preemption-tolerance contract (longer
+    # torn-write windows, later final saves), and restore latency IS
+    # the recovery-time floor after any crash
+    ("ckpt.save_ms", False),
+    ("ckpt.restore_ms", False),
 )
 
 
