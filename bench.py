@@ -54,6 +54,10 @@ PROBE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #               checkpoint bytes, async-save step-overhead A/B, train
 #               chaos-harness outcome, ISSUE 9) — again a new block
 #               with gate-side skip semantics, so no version bump.
+#               r9+: a top-level "tune" block (auto-tuner v2 decision
+#               record: plans enumerated/pruned/trialed, winner
+#               predicted-vs-measured, search seconds, ISSUE 10) —
+#               a new block with gate-side skip semantics, no bump.
 BENCH_VERSION = 3
 BASELINE_BASIS = ("sampled-softmax vs full-softmax LM1B at the same "
                   "memory-limited batch; headline measured separately at "
@@ -562,6 +566,39 @@ def worker_main():
             print(f"# decode bench failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
 
+    # Auto-tuner block (ISSUE 10): one MeshSearch decision end to end
+    # on the smoke-scale flagship — candidates enumerated / pruned /
+    # trialed, predicted-vs-measured ms for the measured winner,
+    # search wall seconds and the engine-cache counters that prove
+    # trials reuse compiles. tools/check_regression.py secondary-gates
+    # tune.search_seconds and (two-sided) tune.predicted_over_measured
+    # drift. Runs in a SUBPROCESS (tools/bench_tune.py): a multi-mesh
+    # search in-process is the known XLA:CPU hard-crash workload, and
+    # an abort must cost this round its tune block, not the whole
+    # artifact. The child pins itself to CPU (on a TPU round the
+    # worker holds the chip claim; the block stamps its platform), so
+    # the ratio is CPU-relative — cross-round DRIFT is the gated
+    # signal, never the absolute value. PARALLAX_BENCH_TUNE=0 skips.
+    tune_snap = None
+    if os.environ.get("PARALLAX_BENCH_TUNE", "1") != "0":
+        try:
+            here = os.path.dirname(os.path.abspath(__file__))
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "tools",
+                                              "bench_tune.py")],
+                env=dict(os.environ), capture_output=True, text=True,
+                timeout=600)
+            start = proc.stdout.find("{")
+            if proc.returncode == 0 and start >= 0:
+                tune_snap = json.loads(proc.stdout[start:])
+            else:
+                print(f"# tune bench child failed rc="
+                      f"{proc.returncode}: "
+                      f"{(proc.stderr or '')[-200:]}", flush=True)
+        except Exception as e:
+            print(f"# tune bench failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
     # Checkpoint cost block (ISSUE 9): save/restore latency, bytes,
     # and the async-save step-overhead A/B (async critical-path cost
     # vs the synchronous path, amortized over the save cadence —
@@ -677,6 +714,10 @@ def worker_main():
         # checkpoint/recovery costs (ISSUE 9): save/restore latency,
         # bytes, async-vs-sync step-overhead A/B, chaos-harness outcome
         "ckpt": ckpt_snap,
+        # auto-tuner v2 (ISSUE 10): one MeshSearch decision — plans
+        # enumerated/pruned/trialed, winner predicted-vs-measured ms
+        # (CPU-relative off-TPU), search wall seconds, cache hits
+        "tune": tune_snap,
         # same-round A/B under the previous round's harness params,
         # recorded iff bench_version bumped this round (VERDICT r5
         # item 6); tools/check_regression.py requires it to treat a

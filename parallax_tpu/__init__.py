@@ -18,7 +18,7 @@ from parallax_tpu.common.config import (AnomalyConfig, CheckPointConfig,
                                         CommunicationConfig, Config,
                                         MPIConfig, ParallaxConfig, PSConfig,
                                         ProfileConfig, RecoveryConfig,
-                                        ServeConfig)
+                                        ServeConfig, TuneConfig)
 from parallax_tpu.common.lib import parallax_log as log
 from parallax_tpu.core.engine import Model, TrainState
 from parallax_tpu.parallel.partitions import get_partitioner
@@ -26,7 +26,8 @@ from parallax_tpu.runner import parallel_run
 from parallax_tpu.session import (Fetch, ParallaxSession, StepHandle,
                                   materialize)
 from parallax_tpu.serve import ServeSession
-from parallax_tpu import compile, obs, ops, serve, shard  # noqa: A004
+from parallax_tpu import compile, obs, ops, serve, shard, \
+    tune  # noqa: A004
 
 __version__ = "0.1.0"
 
@@ -34,7 +35,8 @@ __all__ = [
     "get_partitioner", "parallel_run", "shard", "log", "Config",
     "ParallaxConfig", "PSConfig", "MPIConfig", "CommunicationConfig",
     "CheckPointConfig", "ProfileConfig", "ServeConfig", "AnomalyConfig",
-    "RecoveryConfig", "Model",
+    "RecoveryConfig", "TuneConfig", "Model",
     "TrainState", "ParallaxSession", "Fetch", "StepHandle",
     "materialize", "compile", "obs", "ops", "serve", "ServeSession",
+    "tune",
 ]

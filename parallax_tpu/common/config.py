@@ -276,6 +276,83 @@ class AnomalyConfig:
 
 
 @dataclasses.dataclass
+class TuneConfig:
+    """Auto-tuner v2 knobs (``parallax_tpu.tune``, ISSUE 10): the
+    cost-model-driven search over ``(dp x tp)`` mesh shapes crossed
+    with run options. ``Config(tune_config=TuneConfig())`` routes the
+    session's planning through :class:`~parallax_tpu.tune.search.
+    MeshSearch`; ``tune_config=None`` (default) keeps the legacy 1-D
+    ``PartitionSearch`` behavior.
+
+    * ``enabled``: master switch (a constructed-but-disabled config
+      documents intent without changing planning).
+    * ``top_k``: how many cost-model-shortlisted plans pay a MEASURED
+      trial; everything else is priced analytically only.
+    * ``run_options``: the run-option axis of the search space
+      (default: AR, SHARD and HYBRID; legacy MPI/PS aliases accepted).
+    * ``min_tp`` / ``max_tp``: bounds on the shard-axis width
+      candidates (divisors of the device count within the range).
+    * ``trial_steps`` / ``trial_warmup``: steps per measured trial;
+      the MEDIAN over steps ``[trial_warmup, trial_steps)`` is the
+      trial's time (robust to a single host stall inside the short
+      window; the partition search keeps the reference's mean over
+      its 100-step windows — which would dwarf the whole point of
+      the cost-model prune here).
+    * ``peak_flops`` / ``hbm_gbps`` / ``ici_gbps``: cost-model
+      constant overrides (per device; GB/s for the bandwidths). Unset,
+      the model resolves the chip's published peak where known and
+      otherwise falls back to nominal TPU-class constants — rankings
+      stay meaningful, absolute predictions are CPU-relative.
+    """
+
+    enabled: bool = True
+    top_k: int = 3
+    run_options: Optional[Sequence[str]] = None
+    min_tp: int = 1
+    max_tp: Optional[int] = None
+    trial_steps: int = 12
+    trial_warmup: int = 4
+    peak_flops: Optional[float] = None
+    hbm_gbps: Optional[float] = None
+    ici_gbps: Optional[float] = None
+
+    def __post_init__(self):
+        if int(self.top_k) < 1:
+            raise ValueError(
+                f"tune top_k must be >= 1, got {self.top_k}")
+        if self.run_options is not None:
+            opts = tuple(normalize_run_option(o)
+                         for o in self.run_options)
+            if not opts:
+                raise ValueError(
+                    "tune run_options must name at least one of "
+                    "AR/SHARD/HYBRID (or be None for all three)")
+            # dedupe, order preserved (the order breaks score ties)
+            self.run_options = tuple(dict.fromkeys(opts))
+        if int(self.min_tp) < 1:
+            raise ValueError(
+                f"tune min_tp must be >= 1, got {self.min_tp}")
+        if self.max_tp is not None and int(self.max_tp) < int(self.min_tp):
+            raise ValueError(
+                f"tune max_tp ({self.max_tp}) must be >= min_tp "
+                f"({self.min_tp})")
+        if int(self.trial_warmup) < 0:
+            raise ValueError(
+                f"tune trial_warmup must be >= 0, got "
+                f"{self.trial_warmup}")
+        if int(self.trial_steps) <= int(self.trial_warmup):
+            raise ValueError(
+                f"tune trial_steps ({self.trial_steps}) must exceed "
+                f"trial_warmup ({self.trial_warmup}); the measured "
+                f"window would be empty")
+        for name in ("peak_flops", "hbm_gbps", "ici_gbps"):
+            v = getattr(self, name)
+            if v is not None and float(v) <= 0:
+                raise ValueError(
+                    f"tune {name} must be > 0 when set, got {v}")
+
+
+@dataclasses.dataclass
 class ServeConfig:
     """Online-serving knobs (``parallax_tpu.serve``, no reference
     analogue — the reference is training-only).
@@ -534,6 +611,14 @@ class ParallaxConfig:
     # ServeConfig docstring and docs/parallax_api.md "Serving".
     serve_config: ServeConfig = dataclasses.field(
         default_factory=ServeConfig)
+    # -- auto-tuner v2 (tune/) -------------------------------------------
+    # Cost-model-driven search over (dp x tp) mesh shapes and run
+    # options (ISSUE 10). None (default) = legacy planning: the
+    # config's run_option + num_partitions / the 1-D PartitionSearch.
+    # A TuneConfig routes session planning through tune.MeshSearch:
+    # the full plan space is priced analytically and only the top_k
+    # shortlist pays measured trials. See the TuneConfig docstring.
+    tune_config: Optional["TuneConfig"] = None
 
     # Injected by parallel_run, mirroring the reference's set_sync /
     # set_resource_info setters (config.py:168-179).
@@ -578,6 +663,12 @@ class ParallaxConfig:
                 self.shape_buckets = resolved
         if not self.bucket_mask_feed:
             raise ValueError("bucket_mask_feed must be a feed name")
+        if self.tune_config is not None \
+                and not isinstance(self.tune_config, TuneConfig):
+            raise ValueError(
+                f"tune_config must be a TuneConfig (or None), got "
+                f"{type(self.tune_config).__name__} — a plain dict "
+                f"here would silently skip the knob validation")
 
     # Reference-style setters (kept so ported driver code works unchanged).
     def set_sync(self, sync: bool) -> None:

@@ -3,8 +3,12 @@
 Two cache layers with different lifetimes:
 
 * ``EngineCache`` (in-process): built ``Engine`` objects keyed by
-  ``(num_partitions, batch-signature)``. The partition search replans
-  by rebuilding the engine per candidate; before this cache the search
+  ``(plan, batch-signature)`` where plan = ``(dp, tp, run_option,
+  sync, local_aggregation)`` — the session's full ``tune.Plan`` key
+  (ISSUE 10: the old ``(num_partitions, sig)`` key collided two plans
+  with equal device counts but different mesh shape or run option
+  into one engine). The auto-searches (partition and mesh) replan by
+  rebuilding the engine per candidate; before this cache the search
   then rebuilt — and re-jitted, and recompiled — the WINNING candidate
   a second time after it had already been measured
   (``session._record_search_time``). A cached engine keeps its jitted
@@ -50,7 +54,7 @@ def enable_persistent_cache(cache_dir: str,
 
 
 class EngineCache:
-    """Built engines keyed by ``(num_partitions, batch-signature)``.
+    """Built engines keyed by ``(plan..., batch-signature)``.
 
     The session keys with the BUCKETED example-batch signature
     (``ParallaxSession._bucketed_example``): ragged and full example

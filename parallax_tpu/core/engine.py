@@ -956,16 +956,19 @@ class Engine:
             raise RuntimeError(
                 "sparse_wire_bytes_per_step() called before any step "
                 "was traced; run at least one session step first")
+        # per-record formulas live in tune/costmodel.py — ONE source of
+        # truth shared with the analytic plan scorer and
+        # tools/wire_bytes_report.py (ISSUE 10): row planes (fwd
+        # psum_scatter + bwd all_gather) carry the TABLE's dtype — a
+        # bf16 table halves them on the wire; id/count planes are
+        # always int32
+        from parallax_tpu.tune import costmodel as tune_costmodel
         sparse_bytes = 0
         per_lookup = []
         for tshape, n_ids, n_cnt, repl_bytes, sparse_repl, elem in \
                 self._lookup_records:
-            dim = int(np.prod(tshape[1:])) if len(tshape) > 1 else 1
-            # row planes (fwd psum_scatter + bwd all_gather) carry the
-            # TABLE's dtype — a bf16 table halves them on the wire;
-            # id/count planes are always int32
-            sparse_bytes += (n_ids * 4 + 2 * n_ids * dim * elem
-                             + n_cnt * 4 + repl_bytes)
+            sparse_bytes += tune_costmodel.lookup_wire_bytes(
+                tshape, n_ids, n_cnt, repl_bytes, elem)
             per_lookup.append({
                 "table_shape": tshape,
                 "ids_on_wire": n_ids,
@@ -982,7 +985,8 @@ class Engine:
                 # the variable's own dtype (cotangent dtype == primal)
                 e = (jnp.dtype(vs.dtype).itemsize
                      if vs.dtype is not None else 4)
-                dense_bytes += 2 * int(np.prod(vs.shape)) * e
+                dense_bytes += tune_costmodel.dense_alternative_bytes(
+                    vs.shape, e)
         return {"sparse_path_bytes": sparse_bytes,
                 "dense_allreduce_bytes": dense_bytes,
                 "per_lookup": per_lookup}

@@ -53,6 +53,19 @@ def row_sharded_spec(ndim: int) -> P:
     return P(AXIS_SHARD, *([None] * (ndim - 1)))
 
 
+def snap_to_divisor(p: int, n: int) -> int:
+    """The shard-axis width actually used for a requested count ``p``
+    on ``n`` devices: clamped to [1, n], then the largest divisor of
+    ``n`` that is <= the request. ONE rule shared by ``build_mesh``'s
+    legacy ``num_partitions`` path and the session's legacy-int ->
+    Plan mapping (``ParallaxSession._default_plan``), so cache keys
+    and built meshes can never disagree about the snap."""
+    p = max(1, min(int(p), int(n)))
+    if n % p != 0:
+        p = max(d for d in range(1, p + 1) if n % d == 0)
+    return p
+
+
 def _slice_of(device) -> int:
     """Connectivity domain of a device: its TPU slice when the runtime
     exposes one (multi-slice pods link slices over DCN, devices within a
@@ -65,13 +78,22 @@ def _slice_of(device) -> int:
 
 
 def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
-               num_partitions: Optional[int] = None) -> Mesh:
+               num_partitions: Optional[int] = None,
+               shape: Optional[Sequence[int]] = None) -> Mesh:
     """Build the ('repl', 'shard') mesh.
 
-    ``num_partitions`` is clamped to a divisor of the device count (the
-    reference's fixed_size_partitioner accepts any count because PS tasks can
-    hold uneven slices; XLA sharding wants even splits, so we snap to the
-    nearest divisor <= requested, logging when we do).
+    ``shape=(dp, tp)`` (the auto-tuner's plan grid, ISSUE 10) pins both
+    axes explicitly: ``dp`` replica rows by ``tp`` shard columns. An
+    explicit shape must tile the device count exactly — the tuner
+    enumerates valid factorizations, so a mismatch here is a caller
+    bug and raises instead of snapping.
+
+    ``num_partitions`` (mutually exclusive with ``shape``) is the
+    legacy 1-D knob: the shard-axis size, clamped to a divisor of the
+    device count (the reference's fixed_size_partitioner accepts any
+    count because PS tasks can hold uneven slices; XLA sharding wants
+    even splits, so we snap to the nearest divisor <= requested,
+    logging when we do).
 
     Devices are ordered so the 'shard' axis nests INSIDE a connectivity
     domain (TPU slice, else host) whenever the shard count divides the
@@ -85,13 +107,23 @@ def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
         devices = jax.devices()
     devices = list(devices)
     n = len(devices)
-    p = num_partitions if num_partitions else n
-    p = max(1, min(p, n))
-    if n % p != 0:
-        snapped = max(d for d in range(1, p + 1) if n % d == 0)
-        parallax_log.warning(
-            "num_partitions=%d does not divide device count %d; "
-            "snapping to %d", p, n, snapped)
+    if shape is not None:
+        if num_partitions is not None:
+            raise ValueError(
+                "build_mesh: pass shape=(dp, tp) OR num_partitions, "
+                "not both")
+        dp, p = (int(shape[0]), int(shape[1]))
+        if dp < 1 or p < 1 or dp * p != n:
+            raise ValueError(
+                f"build_mesh shape {tuple(shape)} does not tile the "
+                f"{n} device(s); dp*tp must equal the device count")
+    else:
+        p = num_partitions if num_partitions else n
+        snapped = snap_to_divisor(p, n)
+        if snapped != max(1, min(p, n)):
+            parallax_log.warning(
+                "num_partitions=%d does not divide device count %d; "
+                "snapping to %d", p, n, snapped)
         p = snapped
     devices = _order_by_domain(devices, p)
     arr = np.empty((n,), dtype=object)

@@ -216,9 +216,10 @@ def build_model(cfg: LM1BConfig, full_softmax: bool = False) -> Model:
                           initial_accumulator_value=1.0))
         sl = SliceAdagrad(cfg.learning_rate,
                           initial_accumulator_value=1.0)
-        return Model(init_fn, loss_fn, optimizer=tx,
-                     slice_updaters={"emb": sl, "softmax_w": sl,
-                                     "softmax_b": sl})
+        return _pin_lstm_replicated(
+            Model(init_fn, loss_fn, optimizer=tx,
+                  slice_updaters={"emb": sl, "softmax_w": sl,
+                                  "softmax_b": sl}))
     if cfg.max_touched_rows and not full_softmax:
         # full_softmax grads touch every softmax_w row, so the touched-
         # rows bound cannot hold there — dense adagrad in that mode.
@@ -241,7 +242,23 @@ def build_model(cfg: LM1BConfig, full_softmax: bool = False) -> Model:
             optax.clip_by_global_norm(cfg.max_grad_norm),
             optax.adagrad(cfg.learning_rate,
                           initial_accumulator_value=1.0))
-    return Model(init_fn, loss_fn, optimizer=tx)
+    return _pin_lstm_replicated(Model(init_fn, loss_fn, optimizer=tx))
+
+
+def _pin_lstm_replicated(model: Model) -> Model:
+    """Pin the LSTM cell weights replicated in every plan.
+
+    They are consumed on their CONTRACTED dim inside the scan, so
+    ZeRO-style row-sharding them (run_option=SHARD, or HYBRID with
+    replicate_variables=False) forces the scan backward to reshard the
+    saved residuals batch->feature inside the transposed while loop —
+    which GSPMD can only do as an involuntary full rematerialization
+    (caught by the tuner-plan remat gate, __graft_entry__ phase 6).
+    Sharded storage of [E+P, 4H] + bias + projection buys ~nothing;
+    the tables and softmax still shard under every run option."""
+    from jax.sharding import PartitionSpec as P
+    model.param_specs.setdefault("lstm/*", P())
+    return model
 
 
 def build_full_softmax_model(cfg: LM1BConfig) -> Model:

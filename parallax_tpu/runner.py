@@ -48,7 +48,10 @@ def parallel_run(model: Model,
     """``num_partitions`` pins the shard-axis size (the reference's
     embedding partition count); env PARALLAX_PARTITIONS overrides it, and
     leaving both unset enables the auto-search when
-    PARALLAX_MIN_PARTITIONS is set."""
+    PARALLAX_MIN_PARTITIONS is set. A ``Config.tune_config`` supersedes
+    the 1-D search entirely: the session plans through
+    ``tune.MeshSearch`` over (dp x tp) mesh shapes and run options,
+    with ``num_partitions`` (when given) only seeding the base plan."""
     config = parallax_config or ParallaxConfig()
     config.set_sync(sync)
 
@@ -83,10 +86,20 @@ def parallel_run(model: Model,
 
     search = None
     min_p = os.environ.get(consts.PARALLAX_MIN_PARTITIONS)
+    tune_on = (config.tune_config is not None
+               and config.tune_config.enabled)
     if os.environ.get(consts.PARALLAX_PARTITIONS):
         num_partitions = get_partitioner()
     elif num_partitions is not None:
-        pass  # explicit argument wins over auto-search
+        pass  # explicit argument wins over the 1-D auto-search
+    elif tune_on:
+        # the mesh auto-tuner (tune/, ISSUE 10) supersedes the 1-D
+        # partition search: the session plans through MeshSearch, with
+        # num_partitions (when given) only seeding the base plan
+        parallax_log.info(
+            "mesh auto-tuner enabled (tune_config): searching "
+            "(dp x tp) x run_option, top_k=%d",
+            config.tune_config.top_k)
     elif config.search_partitions and min_p:
         search = PartitionSearch(int(min_p), jax.device_count())
         num_partitions = search.first_candidate()

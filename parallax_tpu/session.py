@@ -63,6 +63,9 @@ from parallax_tpu.obs.metrics import (JsonlSink, MetricsRegistry,
 from parallax_tpu.obs.timeline import StepTimeline
 from parallax_tpu.profiler import ProfileHook
 from parallax_tpu.parallel.partitions import PartitionSearch
+from parallax_tpu.tune import costmodel as tune_costmodel
+from parallax_tpu.tune.costmodel import Plan
+from parallax_tpu.tune.search import MeshSearch
 
 
 class Fetch:
@@ -258,6 +261,21 @@ class ParallaxSession:
         self._state = None
         self._build_lock = threading.Lock()
         self._search = partition_search
+        # -- auto-tuner v2 (tune/, ISSUE 10) ---------------------------
+        # the full configuration the live engine was built for; every
+        # engine-cache key derives from it, so plans with equal device
+        # counts but different mesh shape / run option can never
+        # collide into one cached engine
+        self._plan: Optional[Plan] = None
+        self._tune_result: Optional[Dict[str, Any]] = None
+        tc = config.tune_config
+        if partition_search is None and tc is not None and tc.enabled:
+            # plan through MeshSearch: the cost model prices the whole
+            # (dp x tp) x run_option space off the base engine's
+            # lowered artifacts and only top_k plans pay measured
+            # trials. PartitionSearch stays the tune_config=None path.
+            self._search = MeshSearch(jax.device_count(), tc,
+                                      self._default_plan())
         self._step_times: List[float] = []
         self._profile = ProfileHook(config.profile_config, worker_id)
         self._last_outputs: Dict[str, Any] = {}
@@ -323,6 +341,7 @@ class ParallaxSession:
                 "recovery": (self._recovery.stats
                              if self._recovery is not None
                              else lambda: None),
+                "tune": lambda: self._tune_result,
             })
         self.health = (HealthMonitor(
             self.metrics, on_nonfinite=self._on_nonfinite,
@@ -336,9 +355,9 @@ class ParallaxSession:
         self._last_dispatch_end: Optional[float] = None
         self._prefetcher = None
         # -- compile-ahead engine (compile/) ----------------------------
-        # built engines keyed by (num_partitions, example-batch
-        # signature): the partition search reuses the measured winner
-        # instead of rebuilding (and recompiling) it
+        # built engines keyed by (full plan, example-batch signature)
+        # — see _build_engine: both auto-searches reuse the measured
+        # winner instead of rebuilding (and recompiling) it
         self._engine_cache = compile_cache.EngineCache(self.metrics)
         # ALL background warmup threads ever started (a second
         # warmup() call must not orphan the first thread — close()
@@ -404,7 +423,39 @@ class ParallaxSession:
                        "data_cursor": self._data_cursor,
                        "restore": dict(info)})
 
-    def _build_engine(self, example_batch, num_partitions):
+    def _default_plan(self, num_partitions: Optional[int] = None
+                      ) -> Plan:
+        """The config's own configuration as a tune Plan: the legacy
+        ``num_partitions`` knob (snapped to a divisor, like
+        ``build_mesh`` always did) becomes the shard-axis width."""
+        n = jax.device_count()
+        tp = mesh_lib.snap_to_divisor(
+            num_partitions if num_partitions else n, n)
+        ps = self._config.communication_config.ps_config
+        return Plan(dp=n // tp, tp=tp,
+                    run_option=self._config.run_option,
+                    sync=self._config.sync,
+                    local_aggregation=ps.local_aggregation)
+
+    def _engine_config(self, plan: Plan):
+        """The config a ``plan``'s engine builds with — the session
+        config with the plan's run options substituted (identity when
+        they already match, the common case)."""
+        import dataclasses as _dc
+        cfg = self._config
+        ps = cfg.communication_config.ps_config
+        if (plan.run_option == cfg.run_option
+                and plan.sync == cfg.sync
+                and plan.local_aggregation == ps.local_aggregation):
+            return cfg
+        comm = _dc.replace(
+            cfg.communication_config,
+            ps_config=_dc.replace(
+                ps, local_aggregation=plan.local_aggregation))
+        return _dc.replace(cfg, run_option=plan.run_option,
+                           sync=plan.sync, communication_config=comm)
+
+    def _build_engine(self, example_batch, plan_or_partitions):
         # Bucket the example up front (no-op without shape_buckets):
         # _last_example_batch is whatever fed last, and a ragged tail
         # landing right before a replan must neither make the winner
@@ -413,25 +464,48 @@ class ParallaxSession:
         # bucketed example keeps 'auto' pinned to the first engine's
         # bucket across replans).
         example_batch = self._bucketed_example(example_batch)
-        # cache key: the (bucketed) example-batch signature — a cached
-        # engine keeps its jitted step's compiled executables, so a
-        # partition replan back onto a measured candidate (above all:
-        # the search winner) costs a lookup + state reshard instead of
-        # a rebuild and a full recompile.
-        key = (num_partitions,
-               bucketing_lib.batch_signature(example_batch))
+        if isinstance(plan_or_partitions, Plan):
+            plan = plan_or_partitions.validate_for(jax.device_count())
+        else:
+            plan = self._default_plan(plan_or_partitions)
+        # cache key: the FULL plan + the (bucketed) example-batch
+        # signature — a cached engine keeps its jitted step's compiled
+        # executables, so a replan back onto a measured candidate
+        # (above all: the search winner) costs a lookup + state
+        # reshard instead of a rebuild and a full recompile. The plan
+        # prefix (ISSUE 10 bugfix) keeps two plans with equal device
+        # counts but different mesh shape or run option from
+        # colliding into one engine.
+        key = plan.cache_key() + (
+            bucketing_lib.batch_signature(example_batch),)
         engine = self._engine_cache.get(key)
         if engine is None:
-            mesh = mesh_lib.build_mesh(num_partitions=num_partitions)
-            engine = engine_lib.Engine(self._model, mesh, self._config,
+            mesh = mesh_lib.build_mesh(shape=(plan.dp, plan.tp))
+            engine = engine_lib.Engine(self._model, mesh,
+                                       self._engine_config(plan),
                                        example_batch,
                                        metrics=self.metrics)
             self._engine_cache.put(key, engine)
         self._engine = engine
+        self._plan = plan
+        if isinstance(self._search, MeshSearch) \
+                and not self._search.started:
+            # price the whole plan space off THIS engine's lowered
+            # artifacts (host-side re-trace at worst, no compile, no
+            # device step), then switch to the shortlist's first
+            # candidate; the base engine stays cached for reuse
+            first = self._search.begin(tune_costmodel.inputs_from_engine(
+                engine, self._config.tune_config))
+            if first.cache_key() != plan.cache_key():
+                parallax_log.info(
+                    "mesh search: first trial %s (base plan %s kept "
+                    "cached)", first.describe(), plan.describe())
+                self._build_engine(example_batch, first)
+                return
         if self._state is None:
             self._state = self._engine.init_state(self._seed)
         else:
-            # Reshard the live state onto the new plan (partition search);
+            # Reshard the live state onto the new plan (auto-search);
             # the reference instead kills and relaunches the cluster
             # (partitions.py:74-138).
             self._state = self._reshard_state(self._state)
@@ -581,10 +655,11 @@ class ParallaxSession:
             # a replan would rebuild the mesh under batches the
             # external pipeline already placed for the old one
             raise ValueError(
-                "run_iter(placed=True) cannot run while the "
-                "partition auto-search is live: a replan would "
-                "invalidate already-placed batches. Finish the "
-                "search first (or disable search_partitions).")
+                "run_iter(placed=True) cannot run while an "
+                "auto-search (partition or mesh) is live: a replan "
+                "would invalidate already-placed batches. Finish the "
+                "search first (or disable search_partitions / "
+                "tune_config).")
         it = iter(batches)
         if int(skip):
             from parallax_tpu.data.prefetch import skip_items
@@ -770,6 +845,20 @@ class ParallaxSession:
     @property
     def engine(self):
         return self._engine
+
+    @property
+    def plan(self) -> Optional[Plan]:
+        """The full configuration the live engine was built for (mesh
+        shape + run options), or None before the engine exists."""
+        return self._plan
+
+    def tune_summary(self) -> Optional[Dict[str, Any]]:
+        """The mesh auto-tuner's decision record once the search has
+        settled (candidates enumerated / pruned / trialed, per-trial
+        predicted-vs-measured ms, the winner's ratio, search wall
+        seconds — see ``tune.MeshSearch.summary``), else None. Also a
+        flight-recorder provider and the bench ``tune`` block."""
+        return self._tune_result
 
     def sparse_overflow_steps(self) -> int:
         """Total row_sparse_adagrad overflow events so far: steps that
@@ -1237,11 +1326,24 @@ class ParallaxSession:
 
     def _record_search_time(self, dt: float) -> None:
         self._step_times.append(dt)
-        warm = consts.NUM_ITERATIONS_FOR_WARMUP
-        test = consts.NUM_ITERATIONS_FOR_TEST
+        mesh_search = isinstance(self._search, MeshSearch)
+        if mesh_search:
+            warm, test = (self._search.trial_warmup,
+                          self._search.trial_steps)
+        else:
+            warm = consts.NUM_ITERATIONS_FOR_WARMUP
+            test = consts.NUM_ITERATIONS_FOR_TEST
         if len(self._step_times) < test:
             return
-        mean_t = float(np.mean(self._step_times[warm:test]))
+        if mesh_search:
+            # median, not mean: mesh-search trial windows are short
+            # (TuneConfig.trial_steps, default 12) and a single host
+            # stall inside one would otherwise misrank near-tied
+            # plans; the partition search keeps the reference's mean
+            # over its 50-step window
+            mean_t = float(np.median(self._step_times[warm:test]))
+        else:
+            mean_t = float(np.mean(self._step_times[warm:test]))
         self._step_times = []
         if jax.process_count() > 1:
             # All processes must take identical re-plan decisions (they
@@ -1251,14 +1353,34 @@ class ParallaxSession:
             from jax.experimental import multihost_utils
             mean_t = float(multihost_utils.process_allgather(
                 np.asarray([mean_t])).mean())
-        nxt = self._search.report(mesh_lib.num_shards(self._engine.mesh),
-                                  mean_t)
+        if mesh_search:
+            nxt = self._search.report(self._plan, mean_t)
+        else:
+            nxt = self._search.report(
+                mesh_lib.num_shards(self._engine.mesh), mean_t)
         if nxt is None:
-            best = self._search.best_partitions()
-            parallax_log.info(
-                "partition search done: best num_partitions=%d", best)
+            if mesh_search:
+                best = self._search.best_plan()
+                # the full decision record — candidates, per-trial
+                # predicted-vs-measured, the winner's ratio — goes to
+                # the flight recorder (provider + one-shot artifact)
+                # and to bench via tune_summary()
+                self._tune_result = self._search.summary()
+                parallax_log.info(
+                    "mesh search done: winner %s (%s)",
+                    best.describe(), self._tune_result.get("winner"))
+                self.flight.trigger("tune_decision", self._tune_result)
+                settled = (best.cache_key()
+                           == self._plan.cache_key())
+            else:
+                best = self._search.best_partitions()
+                parallax_log.info(
+                    "partition search done: best num_partitions=%d",
+                    best)
+                settled = (best
+                           == mesh_lib.num_shards(self._engine.mesh))
             self._search = None
-            if best != mesh_lib.num_shards(self._engine.mesh):
+            if not settled:
                 # the winner was already built (and compiled, and
                 # measured) as a candidate: _build_engine reuses it
                 # from the engine cache
@@ -1268,16 +1390,19 @@ class ParallaxSession:
             dropped = self._engine_cache.prune(keep=self._engine)
             if dropped:
                 parallax_log.info(
-                    "partition search: dropped %d losing candidate "
+                    "auto-search: dropped %d losing candidate "
                     "engine(s) from the cache", dropped)
         else:
-            parallax_log.info("partition search: trying p=%d", nxt)
+            parallax_log.info(
+                "auto-search: trying %s",
+                nxt.describe() if isinstance(nxt, Plan) else f"p={nxt}")
             self._build_engine_from_live(nxt)
 
-    def _build_engine_from_live(self, p: int) -> None:
-        with trace.span("partition.replan", num_partitions=p):
-            example = self._last_example_batch
-            self._build_engine(example, p)
+    def _build_engine_from_live(self, plan_or_partitions) -> None:
+        p = plan_or_partitions
+        label = p.describe() if isinstance(p, Plan) else p
+        with trace.span("partition.replan", plan=label):
+            self._build_engine(self._last_example_batch, p)
 
     # -- feed/fetch conversion (session_context.py:179-233 parity) --------
 

@@ -1,0 +1,39 @@
+"""Auto-tuner v2: cost-model-driven search over mesh shapes and run
+options (ISSUE 10 / ROADMAP item 5).
+
+The paper's core contribution is choosing the parallelization strategy
+(AllReduce vs PS vs HYBRID) for an unmodified single-device program.
+`parallel/partitions.py` reproduces the reference's 1-D partition-count
+search; this package owns the full decision space the reference never
+searched — the `(dp, tp)` mesh grid crossed with
+`run_option in {AR, SHARD, HYBRID}` — and prices it analytically so
+only a top-k shortlist ever pays a measured trial:
+
+* `costmodel` — a pure, unit-testable model scoring a candidate
+  :class:`~parallax_tpu.tune.costmodel.Plan` from lowered-only
+  artifacts (XLA ``cost_analysis`` compute/bytes, the dense-vs-
+  IndexedSlices wire split from the engine's GradientsInfo-equivalent,
+  ``flops.device_peak_flops``) into a predicted step time plus a
+  per-term compute/HBM/interconnect breakdown.
+* `search` — :class:`~parallax_tpu.tune.search.MeshSearch`: enumerate
+  valid ``(dp x tp) x run_option`` plans, prune equivalents, shortlist
+  by predicted time, and send only ``top_k`` candidates to measured
+  trials (`ParallaxSession` drives them, reusing the engine cache so a
+  settled winner costs a lookup, not a rebuild).
+
+Enable with ``Config(tune_config=TuneConfig(...))``; the legacy
+`PartitionSearch` remains the ``tune_config=None`` fallback.
+"""
+
+from parallax_tpu.common.config import TuneConfig
+from parallax_tpu.tune.costmodel import (CostInputs, Plan, PlanCost,
+                                         inputs_from_engine, predict,
+                                         wire_summary)
+from parallax_tpu.tune.search import MeshSearch, emittable_plans, \
+    enumerate_plans
+
+__all__ = [
+    "TuneConfig", "Plan", "PlanCost", "CostInputs", "predict",
+    "inputs_from_engine", "wire_summary", "MeshSearch",
+    "enumerate_plans", "emittable_plans",
+]

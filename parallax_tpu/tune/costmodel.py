@@ -1,0 +1,361 @@
+"""Analytic step-time model over (mesh shape, run option) plans.
+
+Pure and unit-testable: every function here maps plain numbers to plain
+numbers — no jax import, no device touch — so a candidate plan can be
+priced from *lowered-only* artifacts before anything compiles:
+
+* XLA ``cost_analysis`` FLOPs / bytes-accessed of one step
+  (``Engine.step_cost_analysis``),
+* the dense-vs-IndexedSlices wire split from the engine's
+  GradientsInfo-equivalent (``ShardingPlan.var_specs`` + the per-lookup
+  trace records of ``ops/embedding.py`` — the paper's sparsity-aware
+  core),
+* ``common.flops.device_peak_flops`` for the chip's compute ceiling.
+
+The prediction is a three-term roofline:
+
+    step ~= max(compute, HBM) + interconnect
+
+compute and HBM overlap inside the chip (whichever ceiling binds wins);
+collective traffic is first-order serialized against them, except under
+``sync=False`` bounded-staleness plans, where the delayed-gradient
+exchange overlaps the next step's compute and only the excess bills.
+
+Wire terms per plan (N = dp * tp devices, ring all-reduce moves
+``2 * bytes * (k-1)/k``, a one-way gather/scatter ``bytes * (k-1)/k``):
+
+* dense (non-table) grads all-reduce over the full mesh in every run
+  option (the batch axis spans the whole mesh);
+* ``SHARD`` additionally pays the ZeRO storage tax: sharded dense
+  params are all-gathered for fwd+bwd consumption;
+* tables: ``AR`` ships the full dense [V, D] gradient through the same
+  ring; ``SHARD``/``HYBRID`` ship the sparse exchange — the probe
+  trace's recorded (ids + row planes + counts) bytes rescaled to the
+  candidate's shard width, plus the cross-replica combine rescaled to
+  its replica count (estimated from the dense shard-grad psum when the
+  probe mesh had a single replica row and recorded nothing).
+
+HONESTY: absolute seconds are only as good as the bandwidth/peak
+constants — on the CPU rig (unknown peak) the model falls back to
+nominal TPU-class constants, so predictions are *ranking* devices, not
+wall-clock oracles, and every predicted-vs-measured ratio downstream is
+CPU-relative until captured on hardware. The per-term breakdown rides
+into the flight-recorder/bench artifacts so each tuner decision stays
+explainable either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from parallax_tpu.common import consts
+from parallax_tpu.common.config import normalize_run_option
+
+# Nominal per-chip constants used when the running backend doesn't
+# report real ones (CPU rig, unknown hardware): TPU-v4-class ballpark.
+# They set the compute-vs-wire exchange rate of the model, i.e. how
+# many wire bytes cost as much as a FLOP — the plan *ranking* is
+# dominated by the byte terms, which are exact.
+NOMINAL_PEAK_FLOPS = 275e12      # bf16 MXU peak, FLOP/s
+NOMINAL_HBM_BPS = 1.2e12         # HBM bandwidth, bytes/s
+NOMINAL_ICI_BPS = 100e9          # per-device interconnect, bytes/s
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One candidate configuration: mesh shape + run options.
+
+    ``dp`` is the ``'repl'`` axis size (data-parallel replica rows),
+    ``tp`` the ``'shard'`` axis size (row-shard width — the
+    reference's embedding partition count). ``sync`` /
+    ``local_aggregation`` ride along from the session config (the
+    search varies mesh shape and run option); they are part of the
+    plan so the cache key, the cost breakdown, and the dryrun phase
+    list all name the complete configuration.
+    """
+
+    dp: int
+    tp: int
+    run_option: str = consts.RUN_HYBRID
+    sync: bool = True
+    local_aggregation: bool = True
+
+    def __post_init__(self):
+        if int(self.dp) < 1 or int(self.tp) < 1:
+            raise ValueError(
+                f"plan mesh axes must be >= 1, got dp={self.dp} "
+                f"tp={self.tp}")
+        object.__setattr__(self, "dp", int(self.dp))
+        object.__setattr__(self, "tp", int(self.tp))
+        object.__setattr__(self, "run_option",
+                           normalize_run_option(self.run_option))
+        object.__setattr__(self, "sync", bool(self.sync))
+        object.__setattr__(self, "local_aggregation",
+                           bool(self.local_aggregation))
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp
+
+    def validate_for(self, num_devices: int) -> "Plan":
+        """Refuse a plan whose dp*tp product does not tile the mesh."""
+        if self.num_devices != int(num_devices):
+            raise ValueError(
+                f"plan {self.describe()} covers {self.num_devices} "
+                f"devices but the mesh has {num_devices}; dp*tp must "
+                f"equal the device count")
+        return self
+
+    def cache_key(self) -> Tuple:
+        """The engine-cache key prefix: every field that changes the
+        compiled program. Two plans with equal device counts but
+        different mesh shape or run option MUST key apart (ISSUE 10
+        bugfix — the old ``(num_partitions, sig)`` key collided
+        them)."""
+        return (self.dp, self.tp, self.run_option, self.sync,
+                self.local_aggregation)
+
+    def describe(self) -> str:
+        tags = [] if self.sync else ["async"]
+        if not self.local_aggregation:
+            tags.append("noagg")
+        return (f"dp{self.dp}xtp{self.tp}/{self.run_option}"
+                + ("".join("+" + t for t in tags)))
+
+
+@dataclasses.dataclass
+class CostInputs:
+    """Lowered-only artifacts one probe engine yields; the same inputs
+    price every candidate plan (terms are rescaled analytically).
+
+    All byte counts are per-step and mesh-global. ``probe_dp`` /
+    ``probe_tp`` name the mesh the sparse terms were recorded on.
+    """
+
+    flops: float = 0.0            # per-step global FLOPs
+    hbm_bytes: float = 0.0        # per-step bytes accessed (all devices)
+    dense_grad_bytes: int = 0     # non-table gradient bytes per step
+    table_grad_bytes: int = 0     # tables' dense [V, D] gradient bytes
+    sparse_fwd_bytes: int = 0     # sparse shard-exchange bytes at probe
+    sparse_repl_bytes: int = 0    # cross-replica combine bytes at probe
+    probe_dp: int = 1
+    probe_tp: int = 1
+    num_devices: int = 1
+    peak_flops: Optional[float] = None    # per device; None -> nominal
+    hbm_bps: Optional[float] = None
+    ici_bps: Optional[float] = None
+    peak_is_nominal: bool = True  # False iff a real chip peak resolved
+
+    def resolved(self) -> "CostInputs":
+        out = dataclasses.replace(self)
+        if not out.peak_flops:
+            out.peak_flops = NOMINAL_PEAK_FLOPS
+            out.peak_is_nominal = True
+        if not out.hbm_bps:
+            out.hbm_bps = NOMINAL_HBM_BPS
+        if not out.ici_bps:
+            out.ici_bps = NOMINAL_ICI_BPS
+        return out
+
+
+@dataclasses.dataclass
+class PlanCost:
+    """Predicted step time for one plan, with the per-term breakdown
+    that makes the decision explainable (flight recorder / bench)."""
+
+    plan: Plan
+    total_s: float
+    terms: Dict[str, float]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "plan": self.plan.describe(),
+            "dp": self.plan.dp, "tp": self.plan.tp,
+            "run_option": self.plan.run_option,
+            "predicted_ms": round(self.total_s * 1e3, 6),
+            "terms_ms": {k: round(v * 1e3, 6)
+                         for k, v in self.terms.items()},
+        }
+
+
+def ring_allreduce_bytes(payload_bytes: float, k: int) -> float:
+    """Bytes moved on the wire by a k-way ring all-reduce of
+    ``payload_bytes`` (reduce-scatter + all-gather: ~2x(k-1)/k)."""
+    if k <= 1:
+        return 0.0
+    return 2.0 * payload_bytes * (k - 1) / k
+
+
+def gather_bytes(payload_bytes: float, k: int) -> float:
+    """One-way k-way all-gather / reduce-scatter wire bytes."""
+    if k <= 1:
+        return 0.0
+    return float(payload_bytes) * (k - 1) / k
+
+
+def _shard_fraction(k: int) -> float:
+    """(k-1)/k — the fraction of a gathered payload that actually
+    crosses the wire (each device already holds its own shard)."""
+    return 0.0 if k <= 1 else (k - 1) / k
+
+
+def lookup_wire_bytes(table_shape: Sequence[int], n_ids: int,
+                      n_cnt: int, repl_bytes: int,
+                      elem_bytes: int) -> int:
+    """Per-step wire bytes of ONE sharded lookup event — the single
+    source of truth shared by ``Engine.sparse_wire_bytes_per_step``
+    and ``tools/wire_bytes_report.py`` (ISSUE 10 satellite): forward
+    all_gather(ids, int32) + psum_scatter(rows) + backward
+    all_gather(row grads) in the TABLE's dtype, the optional
+    occurrence-count plane (int32), plus the recorded cross-replica
+    combine bytes."""
+    dim = int(np.prod(table_shape[1:])) if len(table_shape) > 1 else 1
+    return int(n_ids * 4 + 2 * n_ids * dim * elem_bytes + n_cnt * 4
+               + repl_bytes)
+
+
+def dense_alternative_bytes(table_shape: Sequence[int],
+                            elem_bytes: int) -> int:
+    """Wire bytes of ring-all-reducing one table's full dense [V, D]
+    gradient (~2 bytes moved per gradient byte) — the reference's
+    AllReduce-everything baseline for that variable."""
+    return int(2 * int(np.prod(table_shape)) * elem_bytes)
+
+
+def wire_summary(wire: Dict[str, Any],
+                 table_elem_bytes: int = 4) -> Dict[str, Any]:
+    """Derived ratios of an ``Engine.sparse_wire_bytes_per_step()``
+    accounting — the math ``tools/wire_bytes_report.py`` used to
+    duplicate inline. The fp32 reference rescales the dense
+    alternative to 4-byte elements (the reference ships fp32 dense
+    gradients whatever the table dtype)."""
+    sparse = int(wire.get("sparse_path_bytes") or 0)
+    dense = int(wire.get("dense_allreduce_bytes") or 0)
+    dense_fp32_ref = dense * 4 // int(table_elem_bytes)
+    return {
+        "sparse_over_dense": (sparse / dense) if dense else None,
+        "dense_fp32_reference_bytes": dense_fp32_ref,
+        "sparse_over_dense_fp32_ref": ((sparse / dense_fp32_ref)
+                                       if dense_fp32_ref else None),
+    }
+
+
+def predict(plan: Plan, inputs: CostInputs) -> PlanCost:
+    """Score one plan. Pure; see the module docstring for the model."""
+    inp = inputs.resolved()
+    n = plan.num_devices
+    compute_s = float(inp.flops) / (n * inp.peak_flops)
+    hbm_s = float(inp.hbm_bytes) / (n * inp.hbm_bps)
+
+    # dense (non-table) grads: full-mesh ring in every run option (the
+    # batch axis spans the whole mesh, so every device holds a full
+    # gradient to combine)
+    wire_dense = ring_allreduce_bytes(inp.dense_grad_bytes, n)
+    # ZeRO storage tax (SHARD): sharded dense params all-gathered for
+    # forward AND backward consumption
+    wire_zero = 0.0
+    if plan.run_option == consts.RUN_SHARD:
+        wire_zero = 2.0 * gather_bytes(inp.dense_grad_bytes, plan.tp)
+
+    # tables: dense ring under AR; sparse exchange otherwise
+    if plan.run_option == consts.RUN_AR:
+        wire_table = ring_allreduce_bytes(inp.table_grad_bytes, n)
+    else:
+        # shard exchange rescaled from the probe's shard width; zero
+        # when tp == 1 (rows are device-local, the engine takes the
+        # plain-gather path)
+        f_probe = _shard_fraction(inp.probe_tp)
+        fwd = (inp.sparse_fwd_bytes * _shard_fraction(plan.tp) / f_probe
+               if f_probe > 0 else
+               # probe never sharded (tp==1 probe): approximate the
+               # exchange with the dense shard-grad ring over tp — an
+               # upper-bound stand-in, logged via the term name
+               ring_allreduce_bytes(inp.table_grad_bytes / max(plan.tp, 1),
+                                    plan.tp))
+        f_repl_probe = _shard_fraction(inp.probe_dp)
+        if inp.sparse_repl_bytes and f_repl_probe > 0:
+            repl = (inp.sparse_repl_bytes
+                    * _shard_fraction(plan.dp) / f_repl_probe)
+        else:
+            # probe mesh had one replica row, so nothing was recorded:
+            # estimate the combine as each shard's dense [rows/tp, D]
+            # grad psum'd over the dp rows
+            repl = ring_allreduce_bytes(
+                inp.table_grad_bytes / max(plan.tp, 1), plan.dp)
+        wire_table = fwd + repl
+
+    wire_bytes = wire_dense + wire_zero + wire_table
+    wire_s = wire_bytes / (n * inp.ici_bps)
+    # sync=False bounded staleness: the delayed-gradient exchange
+    # overlaps the next step's compute; only the excess serializes
+    hidden_s = min(wire_s, compute_s) if not plan.sync else 0.0
+    total = max(compute_s, hbm_s) + (wire_s - hidden_s)
+    return PlanCost(plan=plan, total_s=total, terms={
+        "compute_s": compute_s,
+        "hbm_s": hbm_s,
+        "wire_dense_s": wire_dense / (n * inp.ici_bps),
+        "wire_zero_shard_s": wire_zero / (n * inp.ici_bps),
+        "wire_table_s": wire_table / (n * inp.ici_bps),
+        "wire_hidden_s": hidden_s,
+    })
+
+
+def inputs_from_engine(engine, tune_config=None) -> CostInputs:
+    """Extract :class:`CostInputs` from one built (not necessarily
+    compiled) engine — host-side only: a re-trace + lower at worst,
+    never a device execution. Lives here (duck-typed) so the model
+    stays importable without the engine and the engine can import the
+    shared wire formulas without a cycle."""
+    import jax
+
+    from parallax_tpu.common import flops as flops_lib
+    from parallax_tpu.core import mesh as mesh_lib
+
+    costs = engine.step_cost_analysis(cheap_only=False) or {}
+    flops = float(costs.get("flops") or 0.0)
+    hbm = float(costs.get("bytes accessed")
+                or costs.get("bytes_accessed") or 0.0)
+
+    dense_b = 0
+    table_b = 0
+    for vs in engine.plan.var_specs.values():
+        try:
+            elem = (np.dtype(vs.dtype).itemsize
+                    if vs.dtype is not None else 4)
+        except TypeError:
+            elem = 4
+        nbytes = int(np.prod(vs.shape)) * elem if vs.shape else elem
+        if vs.is_sparse:
+            table_b += nbytes
+        else:
+            dense_b += nbytes
+
+    sparse_fwd = 0
+    sparse_repl = 0
+    for tshape, n_ids, n_cnt, repl_bytes, _sparse_repl, elem in \
+            getattr(engine, "_lookup_records", ()):
+        sparse_fwd += lookup_wire_bytes(tshape, n_ids, n_cnt, 0, elem)
+        sparse_repl += int(repl_bytes)
+
+    mesh = engine.mesh
+    dev = jax.devices()[0]
+    import os
+    peak = flops_lib.device_peak_flops(
+        dev.platform, getattr(dev, "device_kind", ""),
+        os.environ.get("PALLAS_AXON_TPU_GEN"))
+    tc = tune_config
+    return CostInputs(
+        flops=flops, hbm_bytes=hbm,
+        dense_grad_bytes=dense_b, table_grad_bytes=table_b,
+        sparse_fwd_bytes=sparse_fwd, sparse_repl_bytes=sparse_repl,
+        probe_dp=int(mesh.shape[mesh_lib.AXIS_REPL]),
+        probe_tp=int(mesh.shape[mesh_lib.AXIS_SHARD]),
+        num_devices=mesh_lib.num_devices(mesh),
+        peak_flops=(tc.peak_flops if tc and tc.peak_flops else peak),
+        hbm_bps=(tc.hbm_gbps * 1e9 if tc and tc.hbm_gbps else None),
+        ici_bps=(tc.ici_gbps * 1e9 if tc and tc.ici_gbps else None),
+        peak_is_nominal=not bool(
+            (tc and tc.peak_flops) or peak))

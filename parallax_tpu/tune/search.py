@@ -1,0 +1,298 @@
+"""MeshSearch: enumerate -> cost-model prune -> top-k measured trials.
+
+The successor of `parallel/partitions.PartitionSearch` (which measures
+1-D partition counts on a fixed mesh): enumerate every valid
+``(dp x tp)`` factorization of the device count crossed with the run
+options, collapse placement-equivalent plans, score the rest with the
+pure cost model (`tune/costmodel.py`) from ONE probe engine's
+lowered-only artifacts, and hand only the ``top_k`` shortlist to
+measured trials. `ParallaxSession` drives the trials exactly like the
+partition search — N timed steps per candidate, re-jit + in-place
+state reshard between candidates — and the engine cache
+(``compile/cache.py``, keyed on the FULL plan since ISSUE 10) makes
+settling on any measured candidate a dictionary lookup, so search cost
+stays near zero.
+
+Equivalence pruning (recorded, never silent): with ``tp == 1`` the
+shard axis is trivial — row-sharded specs collapse to replicated and
+``embedding_lookup`` takes the plain-gather path — so every
+``tp == 1`` plan is placement-identical to ``AR@(dp=N, tp=1)``;
+conversely ``AR`` ignores the shard axis entirely, so only its
+canonical ``tp == 1`` shape is kept. What survives is exactly the set
+of configurations that compile to distinct programs — the same list
+``__graft_entry__.dryrun_multichip`` proves, so every plan the tuner
+can emit is a plan a driver has run.
+
+The settled winner is stamped with its predicted-vs-measured ratio
+(CPU-relative until captured on hardware — the model's constants are
+nominal off-TPU) and the whole decision record lands in the flight
+recorder and the bench ``tune`` block.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from parallax_tpu.common import consts
+from parallax_tpu.common.lib import parallax_log
+from parallax_tpu.tune import costmodel
+from parallax_tpu.tune.costmodel import CostInputs, Plan, PlanCost
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def enumerate_plans(num_devices: int,
+                    run_options: Optional[Sequence[str]] = None,
+                    sync: bool = True,
+                    local_aggregation: bool = True,
+                    min_tp: int = 1,
+                    max_tp: Optional[int] = None) -> List[Plan]:
+    """The FULL ``(dp x tp) x run_option`` space: one plan per divisor
+    ``tp`` of ``num_devices`` (``dp = num_devices // tp``) per run
+    option, bounded by ``[min_tp, max_tp]``. No equivalence pruning —
+    see :func:`emittable_plans` for the deduped list."""
+    if num_devices < 1:
+        raise ValueError(f"num_devices must be >= 1, got {num_devices}")
+    opts = tuple(run_options) if run_options else (
+        consts.RUN_AR, consts.RUN_SHARD, consts.RUN_HYBRID)
+    hi = min(int(max_tp), num_devices) if max_tp else num_devices
+    out = []
+    for tp in _divisors(num_devices):
+        if tp < int(min_tp) or tp > hi:
+            continue
+        for opt in opts:
+            out.append(Plan(dp=num_devices // tp, tp=tp,
+                            run_option=opt, sync=sync,
+                            local_aggregation=local_aggregation))
+    return out
+
+
+def emittable_plans(num_devices: int,
+                    run_options: Optional[Sequence[str]] = None,
+                    sync: bool = True,
+                    local_aggregation: bool = True,
+                    min_tp: int = 1,
+                    max_tp: Optional[int] = None) -> List[Plan]:
+    """The deduped plan list — every configuration the tuner can
+    actually emit (and the list the multichip dryrun proves).
+
+    Collapsed equivalences: every ``tp == 1`` plan (AR included) is
+    the same all-replicated program, so exactly one survives; AR
+    ignores the shard axis, so only its canonical ``tp == 1`` shape is
+    kept (it survives ``min_tp`` — there is no other shape AR
+    compiles distinctly at)."""
+    opts = tuple(run_options) if run_options else (
+        consts.RUN_AR, consts.RUN_SHARD, consts.RUN_HYBRID)
+    plans = enumerate_plans(num_devices, opts, sync, local_aggregation,
+                            min_tp=1, max_tp=max_tp)
+    out = []
+    seen_replicated = False
+    for p in plans:
+        if p.tp == 1:
+            if seen_replicated or (consts.RUN_AR not in opts
+                                   and int(min_tp) > 1):
+                continue
+            seen_replicated = True
+            out.append(p)
+            continue
+        if p.run_option == consts.RUN_AR:
+            continue  # AR is shard-axis-blind: tp=1 is canonical
+        if p.tp < int(min_tp):
+            continue
+        out.append(p)
+    return out
+
+
+class MeshSearch:
+    """Cost-model-shortlisted measured search over plans.
+
+    Protocol (mirrors PartitionSearch, with Plans for candidates):
+
+    1. the session builds its base-plan engine and calls
+       :meth:`begin` with that engine's :class:`CostInputs`;
+    2. ``begin`` scores the space, records the shortlist, and returns
+       the first candidate plan;
+    3. per measured trial the session calls :meth:`report(plan,
+       mean_step_time)` -> the next candidate, or None when done;
+    4. :meth:`best_plan` is the measured argmin; :meth:`summary` is
+       the full decision record (bench/flight artifacts).
+    """
+
+    def __init__(self, num_devices: int, tune_config,
+                 base_plan: Plan):
+        self.num_devices = int(num_devices)
+        self.cfg = tune_config
+        self.base_plan = base_plan.validate_for(num_devices)
+        self.trial_warmup = int(tune_config.trial_warmup)
+        self.trial_steps = int(tune_config.trial_steps)
+        if not emittable_plans(self.num_devices,
+                               tune_config.run_options,
+                               min_tp=tune_config.min_tp,
+                               max_tp=tune_config.max_tp):
+            # the tp bounds can only be judged against the device
+            # count, which TuneConfig.__post_init__ cannot know —
+            # refuse at construction (parallel_run time), not at the
+            # session's first run()
+            raise ValueError(
+                f"tune_config admits no plan on {self.num_devices} "
+                f"device(s): run_options="
+                f"{tuple(tune_config.run_options or ('AR', 'SHARD', 'HYBRID'))}, "
+                f"min_tp={tune_config.min_tp}, "
+                f"max_tp={tune_config.max_tp} — the [min_tp, max_tp] "
+                f"range must contain a divisor of the device count "
+                f"(or include AR, whose canonical tp=1 plan always "
+                f"qualifies)")
+        self._inputs: Optional[CostInputs] = None
+        self._scored: List[PlanCost] = []
+        self._shortlist: List[Plan] = []
+        self._pruned_equivalent = 0
+        self._pruned_by_cost = 0
+        self._enumerated = 0
+        self._measured: Dict[Tuple, float] = {}
+        self._order: List[Plan] = []
+        self._idx = 0
+        self._best: Optional[Plan] = None
+        self._t0: Optional[float] = None
+        self._t_done: Optional[float] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def started(self) -> bool:
+        return self._inputs is not None
+
+    @property
+    def done(self) -> bool:
+        return self._best is not None
+
+    def begin(self, inputs: CostInputs) -> Plan:
+        """Score the space from one probe's lowered artifacts; returns
+        the first shortlisted candidate to measure."""
+        self._t0 = time.perf_counter()
+        self._inputs = inputs
+        cfg = self.cfg
+        opts = cfg.run_options or (consts.RUN_AR, consts.RUN_SHARD,
+                                   consts.RUN_HYBRID)
+        # the FULL space is enumerated with min_tp=1 so the emittable
+        # list (which keeps AR's canonical tp=1 shape through a
+        # min_tp bound) is always a subset of it and the pruned count
+        # can never go negative or undercount; the double enumeration
+        # is O(divisors x options) — trivially cheap
+        full = enumerate_plans(
+            self.num_devices, opts, sync=self.base_plan.sync,
+            local_aggregation=self.base_plan.local_aggregation,
+            min_tp=1, max_tp=cfg.max_tp)
+        self._enumerated = len(full)
+        plans = emittable_plans(
+            self.num_devices, opts, sync=self.base_plan.sync,
+            local_aggregation=self.base_plan.local_aggregation,
+            min_tp=cfg.min_tp, max_tp=cfg.max_tp)
+        # equivalence-collapsed AND bound-pruned plans both count here;
+        # non-empty is guaranteed by the constructor's bounds check
+        self._pruned_equivalent = len(full) - len(plans)
+        self._scored = sorted(
+            (costmodel.predict(p, inputs) for p in plans),
+            key=lambda pc: pc.total_s)
+        k = min(int(cfg.top_k), len(self._scored))
+        self._shortlist = [pc.plan for pc in self._scored[:k]]
+        self._pruned_by_cost = len(self._scored) - k
+        self._order = list(self._shortlist)
+        self._idx = 0
+        parallax_log.info(
+            "mesh search: %d plan(s) enumerated, %d equivalent + %d "
+            "cost-pruned; trialing top-%d: %s",
+            self._enumerated, self._pruned_equivalent,
+            self._pruned_by_cost, k,
+            [p.describe() for p in self._shortlist])
+        return self._order[0]
+
+    def first_candidate(self) -> Plan:
+        if not self.started:
+            raise RuntimeError("MeshSearch.begin(inputs) must run first")
+        return self._order[0]
+
+    def report(self, plan: Plan, mean_step_time: float
+               ) -> Optional[Plan]:
+        """Record one measured trial; next candidate or None at end."""
+        self._measured[plan.cache_key()] = float(mean_step_time)
+        parallax_log.info("mesh search: %s mean step %.4fs",
+                          plan.describe(), mean_step_time)
+        self._idx += 1
+        if self._idx < len(self._order):
+            return self._order[self._idx]
+        best_key = min(self._measured, key=self._measured.get)
+        self._best = next(p for p in self._order
+                          if p.cache_key() == best_key)
+        self._t_done = time.perf_counter()
+        return None
+
+    def best_plan(self) -> Plan:
+        if self._best is None:
+            raise RuntimeError("mesh search not finished")
+        return self._best
+
+    def tried_plans(self) -> List[Plan]:
+        return list(self._order[:self._idx])
+
+    def predicted(self, plan: Plan) -> Optional[PlanCost]:
+        for pc in self._scored:
+            if pc.plan.cache_key() == plan.cache_key():
+                return pc
+        return None
+
+    # -- the decision record ----------------------------------------------
+
+    def summary(self) -> Dict:
+        """JSON-ready record of the whole decision: candidates
+        enumerated/pruned/trialed, per-trial predicted-vs-measured,
+        the winner's ratio, and search wall seconds. The
+        predicted-vs-measured ratios are honest to the rig they ran
+        on: CPU-relative whenever the model's peak was nominal."""
+        trials = []
+        for p in self.tried_plans():
+            pc = self.predicted(p)
+            m = self._measured.get(p.cache_key())
+            trials.append({
+                "plan": p.describe(),
+                "predicted_ms": (round(pc.total_s * 1e3, 6)
+                                 if pc else None),
+                "measured_ms": (round(m * 1e3, 6)
+                                if m is not None else None),
+                "terms_ms": (pc.as_dict()["terms_ms"] if pc else None),
+            })
+        winner = None
+        if self._best is not None:
+            pc = self.predicted(self._best)
+            m = self._measured[self._best.cache_key()]
+            winner = {
+                "plan": self._best.describe(),
+                "dp": self._best.dp, "tp": self._best.tp,
+                "run_option": self._best.run_option,
+                "predicted_ms": (round(pc.total_s * 1e3, 6)
+                                 if pc else None),
+                "measured_ms": round(m * 1e3, 6),
+                "predicted_over_measured": (
+                    round(pc.total_s / m, 6) if pc and m else None),
+            }
+        inp = self._inputs
+        return {
+            "num_devices": self.num_devices,
+            "candidates_enumerated": self._enumerated,
+            "pruned_equivalent": self._pruned_equivalent,
+            "pruned_by_cost_model": self._pruned_by_cost,
+            "top_k": int(self.cfg.top_k),
+            "trials": trials,
+            "trials_measured": len(self._measured),
+            "winner": winner,
+            "search_seconds": (
+                round(self._t_done - self._t0, 3)
+                if self._t0 is not None and self._t_done is not None
+                else None),
+            "cost_basis": ("nominal-constants (CPU-relative ranking)"
+                           if inp is None or inp.peak_is_nominal
+                           else "device-peak"),
+            "scored": [pc.as_dict() for pc in self._scored],
+        }

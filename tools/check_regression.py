@@ -26,9 +26,11 @@ rounds: a ``regression_note`` string in the current artifact (a human
 wrote down why). Anything else over the threshold fails.
 
 **Secondary gates** (ISSUE 6): between harness-compatible rounds the
-``serve`` and ``decode`` blocks are gated the same way the training
-headline is — one-shot QPS, continuous-decode tokens/sec and TTFT,
-and the cached-decode latency row must not regress unexplained. A
+``serve``, ``decode``, ``ckpt`` and ``tune`` blocks are gated the same
+way the training headline is — one-shot QPS, continuous-decode
+tokens/sec and TTFT, the cached-decode latency row, checkpoint
+save/restore latency, and the auto-tuner's search seconds and
+predicted-over-measured drift must not regress unexplained. A
 gate whose value is missing on either side is SKIPPED (reported), so
 adding a new sub-block never fails the round that introduces it; the
 global ``regression_note`` explains secondary moves too.
@@ -205,6 +207,17 @@ SECONDARY_GATES = (
     # the recovery-time floor after any crash
     ("ckpt.save_ms", False),
     ("ckpt.restore_ms", False),
+    # auto-tuner v2 (ISSUE 10, bench "tune" block): search wall time
+    # must not creep (the cost-model prune is the whole point), and
+    # the winner's predicted/measured ratio is gated in BOTH
+    # directions — two rows on one key make a two-sided drift gate
+    # with the existing directional machinery (the absolute value is
+    # CPU-relative on the CPU rig; cross-round DRIFT is the signal: a
+    # drifting ratio means the cost model and the measured world are
+    # coming apart)
+    ("tune.search_seconds", False),
+    ("tune.predicted_over_measured", False),
+    ("tune.predicted_over_measured", True),
 )
 
 

@@ -118,12 +118,16 @@ def flagship_accounting(n_chips: int = 8, batch_per_chip: int = 128,
     with eng.mesh:
         jax.eval_shape(eng._step_jit, abstract_state, abstract_batch)
     wire = eng.sparse_wire_bytes_per_step()
-    # the reference baseline: TF ships fp32 dense gradients whatever the
-    # table dtype (BASELINE.md). The engine's dense alternative counts
-    # the tables in their OWN dtype; all lm1b tables share table_dtype,
-    # so the fp32 reference is a pure element-size rescale of it.
-    elem = jnp.dtype(cfg.table_dtype).itemsize
-    dense_fp32_ref = wire["dense_allreduce_bytes"] * 4 // elem
+    # Derived ratios come from tune/costmodel.py — the ONE owner of the
+    # wire-byte math (ISSUE 10; this script used to duplicate it).
+    # The reference baseline: TF ships fp32 dense gradients whatever
+    # the table dtype (BASELINE.md). The engine's dense alternative
+    # counts the tables in their OWN dtype; all lm1b tables share
+    # table_dtype, so the fp32 reference is a pure element-size
+    # rescale of it.
+    from parallax_tpu.tune import costmodel
+    summary = costmodel.wire_summary(
+        wire, table_elem_bytes=jnp.dtype(cfg.table_dtype).itemsize)
     return {
         "config": {
             "model": "lm1b", "vocab_size": cfg.vocab_size,
@@ -136,14 +140,11 @@ def flagship_accounting(n_chips: int = 8, batch_per_chip: int = 128,
             "dedup_capacity_overflow_free": overflow_free,
         },
         **wire,
-        "sparse_over_dense": (wire["sparse_path_bytes"]
-                              / wire["dense_allreduce_bytes"]
-                              if wire.get("dense_allreduce_bytes")
-                              else None),
-        "dense_fp32_reference_bytes": dense_fp32_ref,
-        "sparse_over_dense_fp32_ref": (wire["sparse_path_bytes"]
-                                       / dense_fp32_ref
-                                       if dense_fp32_ref else None),
+        "sparse_over_dense": summary["sparse_over_dense"],
+        "dense_fp32_reference_bytes":
+            summary["dense_fp32_reference_bytes"],
+        "sparse_over_dense_fp32_ref":
+            summary["sparse_over_dense_fp32_ref"],
     }
 
 
