@@ -58,6 +58,12 @@ PROBE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #               record: plans enumerated/pruned/trialed, winner
 #               predicted-vs-measured, search seconds, ISSUE 10) —
 #               a new block with gate-side skip semantics, no bump.
+#               r10+: the serve.continuous block gains trace-derived
+#               keys (ttft_decomp phase shares, the per-percentile
+#               dominant-cause report whose p99 keys are secondary-
+#               gated, deadline_miss_budget_consumed) and serve.fleet
+#               gains incident_correlated / ttft_decomp_max_rel_err
+#               (ISSUE 12) — new keys, gate-side skip, no bump.
 BENCH_VERSION = 3
 BASELINE_BASIS = ("sampled-softmax vs full-softmax LM1B at the same "
                   "memory-limited batch; headline measured separately at "
@@ -525,6 +531,18 @@ def worker_main():
                                              if at8 else None),
                     "recompiles": sum(r.get("recompiles", 0)
                                       for r in rows),
+                    # trace-derived keys (ISSUE 12, obs/reqtrace +
+                    # tools/serve_report): per-phase TTFT shares and
+                    # the per-percentile dominant-cause report at the
+                    # 8x level — report.buckets.p99.* is secondary-
+                    # gated by name (tools/check_regression.py)
+                    "ttft_decomp": (at8.get("ttft_decomp")
+                                    if at8 else None),
+                    "deadline_miss_budget_consumed": (
+                        at8.get("deadline_miss_budget_consumed")
+                        if at8 else None),
+                    "report": (at8.get("attribution")
+                               if at8 else None),
                 }
             # Fleet robustness block (ISSUE 7): the chaos harness run
             # end to end — injected replica crash with failover and a

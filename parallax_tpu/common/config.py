@@ -542,6 +542,11 @@ class ParallaxConfig:
     # runs. None = no sink (snapshot() is always available in-process).
     metrics_path: Optional[str] = None
     metrics_interval_s: float = 10.0
+    # Size bound for the JSONL sink file: when an append would cross
+    # it, the file rotates to `<metrics_path>.1` (replacing a previous
+    # rotation) with a loud warning — a long-lived serving fleet must
+    # not fill the disk. None (default) = historical unbounded growth.
+    metrics_max_bytes: Optional[int] = None
     # Opt-in per-step health monitoring: the engine appends in-graph
     # `loss_finite` / `grad_norm` outputs (a few FLOPs next to the
     # backward pass) and the session consumes them LAZILY — only values
@@ -645,6 +650,11 @@ class ParallaxConfig:
             raise ValueError(
                 f"metrics_interval_s must be > 0, got "
                 f"{self.metrics_interval_s}")
+        if self.metrics_max_bytes is not None \
+                and int(self.metrics_max_bytes) <= 0:
+            raise ValueError(
+                f"metrics_max_bytes must be > 0 or None, got "
+                f"{self.metrics_max_bytes}")
         if int(self.trace_buffer_events) < 1:
             raise ValueError(
                 f"trace_buffer_events must be >= 1, got "

@@ -34,6 +34,17 @@ The forensics layer (ISSUE 5) builds on the registry:
     aggregation over the JAX coordinator channel; names the straggler
     host in-artifact.
 
+The serving forensics layer (ISSUE 12) extends it to the request path:
+
+  * :mod:`~parallax_tpu.obs.reqtrace` — per-request lifecycle records
+    (queue-wait / prefill-per-chunk / slot-wait / decode / failover
+    decomposition that sums to client-side TTFT, KV pages, replica hop
+    trails) in a bounded ring, exported as lazy ``serve.timeline.*`` /
+    ``serve.slo.*`` gauges and chrome://tracing lanes keyed by request
+    id.
+  * :mod:`~parallax_tpu.obs.export` — live Prometheus-text telemetry
+    over localhost HTTP (fleet aggregates + per-replica registries).
+
 ``disable()`` / ``enable()`` (or env ``PARALLAX_OBS=0``) switch the
 whole layer to near-free no-ops process-wide;
 `tools/check_obs_overhead.py` holds the enabled path to <=2% of step
@@ -41,8 +52,9 @@ wall-time.
 """
 
 from parallax_tpu.obs._state import disable, enable, is_enabled
-from parallax_tpu.obs import (aggregate, anomaly, flightrec, health,
-                              metrics, timeline, trace)
+from parallax_tpu.obs import (aggregate, anomaly, export, flightrec,
+                              health, metrics, reqtrace, timeline,
+                              trace)
 from parallax_tpu.obs.aggregate import (aggregate_host_step_times,
                                         find_stragglers)
 from parallax_tpu.obs.anomaly import AnomalyEvent, AnomalyMonitor
@@ -51,16 +63,20 @@ from parallax_tpu.obs.health import HealthMonitor, device_memory_stats
 from parallax_tpu.obs.metrics import (Counter, Gauge, Histogram,
                                       JsonlSink, MetricsRegistry,
                                       PipelineStats)
+from parallax_tpu.obs.export import TelemetryExporter
+from parallax_tpu.obs.reqtrace import RequestRecord, RequestTraceRing
 from parallax_tpu.obs.timeline import StepTimeline
 from parallax_tpu.obs.trace import (TraceCollector, TraceEvent,
                                     export_chrome_trace, span)
 
 __all__ = [
     "trace", "metrics", "health", "timeline", "flightrec", "anomaly",
-    "aggregate", "span", "TraceCollector", "TraceEvent",
-    "export_chrome_trace", "MetricsRegistry", "Counter", "Gauge",
-    "Histogram", "JsonlSink", "PipelineStats", "HealthMonitor",
+    "aggregate", "reqtrace", "export", "span", "TraceCollector",
+    "TraceEvent", "export_chrome_trace", "MetricsRegistry", "Counter",
+    "Gauge", "Histogram", "JsonlSink", "PipelineStats", "HealthMonitor",
     "device_memory_stats", "StepTimeline", "FlightRecorder",
-    "AnomalyMonitor", "AnomalyEvent", "aggregate_host_step_times",
-    "find_stragglers", "enable", "disable", "is_enabled",
+    "AnomalyMonitor", "AnomalyEvent", "RequestRecord",
+    "RequestTraceRing", "TelemetryExporter",
+    "aggregate_host_step_times", "find_stragglers", "enable",
+    "disable", "is_enabled",
 ]

@@ -18,7 +18,10 @@ Auto-dumps require ``Config(flight_dir=...)`` (a training framework
 must not write files nobody asked for); ``dump()`` with an explicit
 path always works. Dumps are rate-limited — one per distinct reason,
 ``max_dumps`` total — so a NaN storm produces one artifact, not
-thousands.
+thousands; every suppressed trigger stays visible through the
+``flightrec.suppressed.<class>`` registry counters instead of
+vanishing, and each artifact carries a process-unique ``incident_id``
+so fleet-correlated consumers can join it across logs and metrics.
 
 The artifact is self-contained: trigger reason + detail, the step
 rows (with the goodput account), health readings, anomaly events, the
@@ -30,6 +33,7 @@ must not lose the rest of the post-mortem.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -64,6 +68,11 @@ class FlightRecorder:
         self._max_dumps = int(max_dumps)
         self._seen_reasons: set = set()
         self.dump_paths: list = []
+        # incident correlation (ISSUE 12): every artifact carries a
+        # process-unique incident id so fleet-wide consumers can join
+        # "this crash" across logs, metrics and the artifact itself
+        self._incident_seq = itertools.count(1)
+        self.last_incident_id: Optional[str] = None
 
     def add_provider(self, name: str, fn: Callable[[], Any]) -> None:
         self._providers[name] = fn
@@ -83,6 +92,11 @@ class FlightRecorder:
             if (key in self._seen_reasons
                     or len(self.dump_paths) >= self._max_dumps):
                 self._suppressed.inc()
+                # per-class visibility (ISSUE 12 satellite): a 9th
+                # incident of a class must leave a countable trace,
+                # not vanish — flightrec.suppressed.<class> names it
+                self._registry.counter(
+                    "flightrec.suppressed." + key).inc()
                 return None
             # claimed BEFORE dumping so a concurrent trigger of the
             # same class cannot double-dump...
@@ -111,8 +125,12 @@ class FlightRecorder:
                 reason.split(":", 1)[0].replace("/", "_"), os.getpid(),
                 time.strftime("%Y%m%d-%H%M%S"))
             path = os.path.join(base, fname)
+        incident_id = "inc-%d-%d" % (os.getpid(),
+                                     next(self._incident_seq))
+        self.last_incident_id = incident_id
         doc: Dict[str, Any] = {
             "reason": reason,
+            "incident_id": incident_id,
             "detail": detail,
             "ts": time.time(),
             "pid": os.getpid(),

@@ -25,10 +25,12 @@ from __future__ import annotations
 import collections
 import json
 import math
+import os
 import threading
 import time
 from typing import Dict, Optional
 
+from parallax_tpu.common.lib import parallax_log
 from parallax_tpu.obs import _state
 
 
@@ -95,6 +97,19 @@ class Gauge:
         return self.value
 
 
+def nearest_rank(window, q: float):
+    """The q-quantile of a SORTED window by the nearest-rank method
+    (None when empty). A truncating index would report p95 BELOW p50
+    on tiny windows (n=2 -> index 0, the minimum). THE quantile rule
+    of this repo — histogram summaries, loadgen percentiles and the
+    serve attribution report all share it, so the same data can never
+    summarize two ways."""
+    n = len(window)
+    if n == 0:
+        return None
+    return window[min(n - 1, max(0, math.ceil(q * n) - 1))]
+
+
 def summarize_window(window, count: int) -> Optional[Dict[str, float]]:
     """{count, mean, p50, p95, max} for a SORTED sample window (None
     when empty). Shared by Histogram.snapshot and any component keeping
@@ -103,16 +118,11 @@ def summarize_window(window, count: int) -> Optional[Dict[str, float]]:
     if n == 0:
         return None
 
-    def rank(q):
-        # nearest-rank: a truncating index would report p95 BELOW p50
-        # on tiny windows (n=2 -> index 0, the minimum)
-        return window[min(n - 1, math.ceil(q * n) - 1)]
-
     return {
         "count": count,
         "mean": sum(window) / n,
-        "p50": rank(0.50),
-        "p95": rank(0.95),
+        "p50": nearest_rank(window, 0.50),
+        "p95": nearest_rank(window, 0.95),
         "max": window[-1],
     }
 
@@ -206,17 +216,30 @@ class JsonlSink:
     """Background thread appending one ``registry.snapshot()`` JSON line
     to ``path`` every ``interval_s`` seconds (plus a final line at
     ``stop()``, so short runs still leave a record). Each line carries a
-    wall-clock ``ts`` so scrapers can align runs."""
+    wall-clock ``ts`` so scrapers can align runs.
+
+    ``max_bytes`` bounds the file for long-lived processes (a serving
+    fleet scraping every 10s fills a disk in weeks): when appending
+    would exceed it, the current file rotates to ``<path>.1``
+    (replacing any previous rotation — at most 2x ``max_bytes`` on
+    disk) with a loud log line. Default None keeps the historical
+    grow-forever behavior."""
 
     def __init__(self, registry: MetricsRegistry, path: str,
                  interval_s: float = 10.0,
-                 snapshot_fn: Optional[callable] = None):
+                 snapshot_fn: Optional[callable] = None,
+                 max_bytes: Optional[int] = None):
         if interval_s <= 0:
             raise ValueError(
                 f"metrics_interval_s must be > 0, got {interval_s}")
+        if max_bytes is not None and int(max_bytes) <= 0:
+            raise ValueError(
+                f"metrics_max_bytes must be > 0 or None, got "
+                f"{max_bytes}")
         self._registry = registry
         self._path = path
         self._interval = float(interval_s)
+        self._max_bytes = int(max_bytes) if max_bytes else None
         # richer snapshot (the session's metrics_snapshot refreshes
         # polled gauges first); may touch live device state, so any
         # failure — e.g. racing a donated buffer — falls back to the
@@ -238,14 +261,35 @@ class JsonlSink:
         if snap is None:
             snap = self._registry.snapshot()
         try:
+            # default=str: user gauges can hold np/jax scalars; a
+            # TypeError here would kill the sink thread for the
+            # rest of the run
+            line = json.dumps({"ts": time.time(), "metrics": snap},
+                              default=str) + "\n"
+            self._maybe_rotate(len(line))
             with open(self._path, "a") as f:
-                # default=str: user gauges can hold np/jax scalars; a
-                # TypeError here would kill the sink thread for the
-                # rest of the run
-                f.write(json.dumps({"ts": time.time(), "metrics": snap},
-                                   default=str) + "\n")
+                f.write(line)
         except OSError:
             pass
+
+    def _maybe_rotate(self, incoming: int) -> None:
+        """Size-bounded rotation: roll ``path`` -> ``path.1`` when the
+        next line would cross ``max_bytes``. LOUD by design — a
+        rotation means history is being discarded."""
+        if self._max_bytes is None:
+            return
+        try:
+            size = os.path.getsize(self._path)
+        except OSError:
+            return  # no file yet
+        if size == 0 or size + incoming <= self._max_bytes:
+            return
+        rotated = self._path + ".1"
+        os.replace(self._path, rotated)
+        parallax_log.warning(
+            "metrics sink rotated %s (%d bytes >= metrics_max_bytes="
+            "%d) to %s; older history discarded", self._path, size,
+            self._max_bytes, rotated)
 
     def _loop(self) -> None:
         while not self._stop.wait(self._interval):

@@ -106,10 +106,18 @@ class Request:
     are ``time.perf_counter()`` seconds: ``t_enqueue`` at submit,
     ``deadline`` absolute (None = no deadline), ``t_done`` when the
     result (or failure) landed.
+
+    ``rec`` is the request's lifecycle record
+    (:class:`~parallax_tpu.obs.reqtrace.RequestRecord`, attached by the
+    owning session; None with the obs layer disabled). Terminal
+    transitions finalize it here — the single completion point —
+    so every path (delivery, batch failure, deadline expiry in queue /
+    at dispatch / mid-decode, replica death) lands in the request
+    timeline without each call site having to remember to.
     """
 
     __slots__ = ("id", "feed", "deadline", "group_key", "max_new_tokens",
-                 "t_enqueue", "t_done", "t_first_token", "_event",
+                 "t_enqueue", "t_done", "t_first_token", "rec", "_event",
                  "_result", "_error", "_callbacks")
 
     def __init__(self, feed: Dict[str, Any],
@@ -124,6 +132,7 @@ class Request:
         self.t_enqueue = time.perf_counter()
         self.t_done: Optional[float] = None
         self.t_first_token: Optional[float] = None
+        self.rec = None
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -175,12 +184,26 @@ class Request:
 
     def _complete(self, result) -> None:
         self.t_done = time.perf_counter()
+        if self.rec is not None:
+            # finalized BEFORE the event fires: a fleet done-callback
+            # reading the record sees the completed decomposition
+            self.rec.complete(self.t_done)
         self._result = result
         self._event.set()
         self._drain_callbacks()
 
     def _fail(self, exc: BaseException) -> None:
         self.t_done = time.perf_counter()
+        if self.rec is not None:
+            if isinstance(exc, DeadlineExceeded):
+                # a spent budget is final at every tier — no retry can
+                # unmiss a deadline, so the record closes here
+                self.rec.complete(self.t_done,
+                                  outcome="deadline_exceeded")
+            else:
+                # a fleet-owned record stays open for failover; a
+                # standalone one finalizes with the failure class
+                self.rec.attempt_failed(type(exc).__name__, self.t_done)
         self._error = exc
         self._event.set()
         self._drain_callbacks()

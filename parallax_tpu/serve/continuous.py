@@ -335,6 +335,11 @@ class ContinuousScheduler:
 
     def _activate(self, j: int, req: Request, pages: List[int],
                   rs) -> None:
+        if req.rec is not None:
+            # prefill done, slot owned: everything from here to retire
+            # is the decode phase of the request timeline
+            req.rec.mark("decode")
+            req.rec.kv_pages = len(pages)
         self._state = self._program.insert(self._state, np.int32(j), rs)
         self._slots[j] = _Slot(req, req.max_new_tokens, pages)
         self._tok[j] = self._program.bos_id
@@ -354,10 +359,16 @@ class ContinuousScheduler:
             req = self._queue.pop(timeout=0.0)
             if req is None:
                 return
+            if req.rec is not None:
+                req.rec.mark("prefill")
             self._refilling = True
             try:
                 pages = self._alloc_pages(req)
                 if pages is None:
+                    if req.rec is not None:
+                        # pool exhausted: the wait back at the queue
+                        # head is slot/page pressure, not queue depth
+                        req.rec.mark("slot_wait")
                     self._queue.requeue_front(req)
                     return
                 with trace.span("serve.prefill", slot=j, id=req.id):
@@ -385,20 +396,28 @@ class ContinuousScheduler:
             req = self._queue.pop(timeout=0.0)
             if req is None:
                 return
+            if req.rec is not None:
+                req.rec.mark("prefill")
             self._refilling = True
             try:
                 pages = self._alloc_pages(req)
                 if pages is None:
+                    if req.rec is not None:
+                        req.rec.mark("slot_wait")
                     self._queue.requeue_front(req)
                     return
                 self._pending.append(_Prefill(req, j, pages))
             finally:
                 self._refilling = False
         pp = self._pending[0]
+        t_chunk = time.perf_counter()
         with trace.span("serve.prefill_chunk", slot=pp.slot,
                         id=pp.req.id, k=pp.k):
             pp.carry = self._program.prefill_chunk(self._params,
                                                    pp.carry, pp.k)
+        if pp.req.rec is not None:
+            pp.req.rec.note_prefill_chunk(
+                (time.perf_counter() - t_chunk) * 1e3)
         pp.k += 1
         self._chunk_ctr.inc()
         if pp.k == self._chunks:
@@ -413,11 +432,22 @@ class ContinuousScheduler:
         self._release_pages(slot.pages)
         self._clear_slot(j)
         req = slot.req
+        rec = req.rec
+        if rec is not None:
+            rec.tokens = len(slot.tokens)
+            rec.decode_steps = int(slot.t)
         req._complete(np.asarray(slot.tokens, np.int32))
         self._completed.inc()
         self._latency.record((now - req.t_enqueue) * 1e3)
-        trace.record_span("serve.request", req.t_enqueue, now,
-                          id=req.id, tokens=len(slot.tokens))
+        # ONE span per logical request, emitted by the delivering
+        # replica only (a crashed hop never retires), carrying the
+        # final replica id and hop count — the failover-visibility
+        # contract tests/test_fleet.py asserts
+        trace.record_span(
+            "serve.request", req.t_enqueue, now, id=req.id,
+            tokens=len(slot.tokens), replica=self._replica_id,
+            rid=(rec.key if rec is not None else req.id),
+            hops=(len(rec.hops) if rec is not None else 1))
 
     def _expire_slots(self, now: float) -> None:
         n_expired = 0
@@ -476,6 +506,8 @@ class ContinuousScheduler:
         if slot.req.t_first_token is None:
             slot.req.t_first_token = now
             self._ttft.record((now - slot.req.t_enqueue) * 1e3)
+            if slot.req.rec is not None:
+                slot.req.rec.first_token(now)
         slot.tokens.append(token)
         slot.t += 1
         self._prev[j] = self._tok[j]

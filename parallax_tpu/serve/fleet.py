@@ -51,7 +51,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from parallax_tpu.common.lib import parallax_log
-from parallax_tpu.obs import metrics as obs_metrics, trace
+from parallax_tpu.obs import _state as obs_state
+from parallax_tpu.obs import metrics as obs_metrics, reqtrace, trace
 from parallax_tpu.serve.batcher import (DeadlineExceeded,
                                         ReplicaUnavailable, ServeClosed,
                                         ServeError, ServeOverloaded)
@@ -125,7 +126,7 @@ class FleetRequest:
 
     __slots__ = ("id", "feed", "deadline", "max_new_tokens",
                  "t_enqueue", "t_done", "t_first_token", "replicas",
-                 "_event", "_result", "_error", "_lock")
+                 "rec", "_event", "_result", "_error", "_lock")
 
     def __init__(self, feed, deadline: Optional[float],
                  max_new_tokens: Optional[int]):
@@ -137,6 +138,10 @@ class FleetRequest:
         self.t_done: Optional[float] = None
         self.t_first_token: Optional[float] = None
         self.replicas: List[Any] = []
+        # the fleet-owned lifecycle record (obs/reqtrace.py): ONE
+        # record across every failover hop, so the TTFT decomposition
+        # covers the whole client-visible window; None when obs is off
+        self.rec = None
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -168,6 +173,10 @@ class FleetRequest:
             self.t_first_token = t_first_token
             self._result = result
             self._event.set()
+        if self.rec is not None:
+            # normally already finalized by the delivering replica's
+            # Request._complete (same shared record) — idempotent
+            self.rec.complete(self.t_done)
 
     def _fail(self, exc: BaseException) -> None:
         with self._lock:
@@ -176,6 +185,14 @@ class FleetRequest:
             self.t_done = time.perf_counter()
             self._error = exc
             self._event.set()
+        if self.rec is not None:
+            # idempotent: a sub-request terminal outcome (delivery,
+            # deadline) may have finalized the shared record already
+            self.rec.complete(
+                self.t_done,
+                outcome=("deadline_exceeded"
+                         if isinstance(exc, DeadlineExceeded)
+                         else f"failed:{type(exc).__name__}"))
 
 
 class ServeFleet:
@@ -215,6 +232,13 @@ class ServeFleet:
                               on_state_change=self._on_state_change)
         self._rid = itertools.count()
         self._registries: Dict[Any, obs_metrics.MetricsRegistry] = {}
+        # request forensics (ISSUE 12): the fleet-level lifecycle ring
+        # (failed-over requests keep ONE record across hops) and the
+        # in-flight table the correlated incident dump captures
+        self.reqtrace = reqtrace.RequestTraceRing(self.metrics)
+        self._inflight: Dict[Any, FleetRequest] = {}
+        self._inflight_lock = threading.Lock()
+        self._exporter = None
         self._closed = False
         self._swap_lock = threading.Lock()
         self._scale_lock = threading.Lock()
@@ -250,9 +274,19 @@ class ServeFleet:
             self._add_replica()
         self._update_gauges()
         if self._flight is not None:
-            # the fleet section rides along in every subsequent flight
-            # dump, whatever triggered it
+            # correlated incident dumps (ISSUE 12): every subsequent
+            # flight artifact — whatever triggered it — carries the
+            # fleet aggregates, the router's health + circuit-breaker
+            # states, the live in-flight request table (with hop
+            # trails) and the recent completed-request records, all in
+            # ONE artifact stamped with a shared incident id
             self._flight.add_provider("fleet", self.stats)
+            self._flight.add_provider("router", self._router_snapshot)
+            self._flight.add_provider("requests_in_flight",
+                                      self._inflight_snapshot)
+            self._flight.add_provider(
+                "request_records",
+                lambda: self.reqtrace.records(last=64))
 
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -317,10 +351,15 @@ class ServeFleet:
         self._router.eject(rid, reason=f"fatal: {exc}", permanent=True)
         self._update_gauges()
         if self._flight is not None:
+            # by this point the dead replica's requests have already
+            # been failed over (the scheduler's failure cascade runs
+            # the done-callbacks synchronously before on_fatal), so
+            # the affected set carries the post-failover hop trails
             self._flight.trigger(
                 f"fleet_crash:replica_{rid}",
                 {"replica": rid,
-                 "error": f"{type(exc).__name__}: {exc}"})
+                 "error": f"{type(exc).__name__}: {exc}",
+                 "affected_requests": self._affected_by(rid)})
         if self._anomaly is not None:
             # the failover surge is deliberate recovery, not a quiet
             # regression — rebaseline instead of firing a change-point
@@ -374,13 +413,81 @@ class ServeFleet:
         deadline = (time.perf_counter() + float(deadline_ms) / 1e3
                     if deadline_ms is not None else None)
         freq = FleetRequest(feed, deadline, max_new_tokens)
+        if obs_state.enabled:
+            freq.rec = reqtrace.RequestRecord(
+                freq.id, t0=freq.t_enqueue, deadline=deadline,
+                ring=self.reqtrace, fleet_owned=True)
         self._requests.inc()
+        with self._inflight_lock:
+            self._inflight[freq.id] = freq
         try:
             self._dispatch(freq, exclude=())
         except ServeOverloaded:
             self._shed.inc()
+            self._untrack(freq, outcome="shed")
+            raise
+        except BaseException as e:
+            # keep one label per SLO event class: a deadline spent
+            # before placement is the same miss as one spent inside a
+            # replica (batcher/FleetRequest._fail use the same label)
+            self._untrack(freq, outcome=(
+                "deadline_exceeded" if isinstance(e, DeadlineExceeded)
+                else f"failed:{type(e).__name__}"))
             raise
         return freq
+
+    def _untrack(self, freq: FleetRequest,
+                 outcome: Optional[str] = None) -> None:
+        """Drop a request from the in-flight table (terminal); with an
+        ``outcome``, also finalize its record (synchronous admission
+        failures never reach a sub-request's completion hook)."""
+        with self._inflight_lock:
+            self._inflight.pop(freq.id, None)
+        if outcome is not None and freq.rec is not None:
+            freq.rec.complete(outcome=outcome)
+
+    def request_records(self, last: Optional[int] = None):
+        """Snapshots of recently completed fleet request records."""
+        return self.reqtrace.records(last)
+
+    def _inflight_snapshot(self) -> List[Dict]:
+        """The live request table: id, hop trail, deadline headroom and
+        the lifecycle record so far — the incident dump's 'who was
+        affected' section."""
+        now = time.perf_counter()
+        out = []
+        with self._inflight_lock:
+            freqs = list(self._inflight.values())
+        for f in freqs:
+            row = (f.rec.snapshot() if f.rec is not None
+                   else {"id": f.id})
+            row["hops"] = list(f.replicas)
+            row["deadline_remaining_ms"] = (
+                round((f.deadline - now) * 1e3, 3)
+                if f.deadline is not None else None)
+            out.append(row)
+        return out
+
+    def _router_snapshot(self) -> List[Dict]:
+        now = time.perf_counter()
+        return [dict(h.snapshot(now), rid=h.rid)
+                for h in self._router.handles()]
+
+    def _affected_by(self, rid) -> List[Dict]:
+        """Every request whose hop trail touches replica ``rid`` —
+        still in flight (failing over right now) or recently completed
+        (the retry may already have landed by dump time)."""
+        out: Dict[Any, List] = {}
+        with self._inflight_lock:
+            freqs = list(self._inflight.values())
+        for f in freqs:
+            if rid in f.replicas:
+                out[f.id] = list(f.replicas)
+        for r in self.reqtrace.records():
+            if rid in (r.get("hops") or ()):
+                out.setdefault(r["id"], list(r["hops"]))
+        return [{"id": k, "hops": v}
+                for k, v in sorted(out.items(), key=lambda kv: str(kv[0]))]
 
     def _remaining_ms(self, freq: FleetRequest) -> Optional[float]:
         if freq.deadline is None:
@@ -408,7 +515,7 @@ class ServeFleet:
             try:
                 sub = handle.session.submit(
                     freq.feed, deadline_ms=remaining,
-                    max_new_tokens=freq.max_new_tokens)
+                    max_new_tokens=freq.max_new_tokens, rec=freq.rec)
             except ServeError as e:
                 exclude = exclude + (handle.rid,)
                 any_shed = any_shed or isinstance(e, ServeOverloaded)
@@ -438,12 +545,14 @@ class ServeFleet:
             self._completed.inc()
             self._latency.record(
                 (time.perf_counter() - freq.t_enqueue) * 1e3)
+            self._untrack(freq)
             return
         if isinstance(err, DeadlineExceeded):
             # shedding on time is the deadline contract working, not a
             # replica fault — and the budget is spent: no retry
             self._timeouts.inc()
             freq._fail(err)
+            self._untrack(freq)
             return
         self._record_request_error(handle.rid, err)
         retryable = bool(getattr(err, "retryable", False))
@@ -454,8 +563,14 @@ class ServeFleet:
                 or (remaining is not None and remaining <= 0)):
             self._failed.inc()
             freq._fail(err)
+            self._untrack(freq)
             return
         self._retries.inc()
+        if freq.rec is not None:
+            # the gap from this failure to the next placement is the
+            # failover phase of the request timeline
+            freq.rec.mark("failover")
+            freq.rec.note_retry()
         if isinstance(err, ReplicaUnavailable):
             self._failovers.inc()
         parallax_log.warning(
@@ -468,6 +583,7 @@ class ServeFleet:
         except Exception as e:
             self._failed.inc()
             freq._fail(e)
+            self._untrack(freq)
 
     # -- hot-swap (zero-downtime weight push) ------------------------------
 
@@ -699,6 +815,28 @@ class ServeFleet:
 
     # -- introspection / teardown ------------------------------------------
 
+    def start_exporter(self, port: int = 0):
+        """Serve the fleet's live telemetry (fleet aggregates PLUS
+        every replica's ``serve.*`` registry, ``source``-labeled) as
+        Prometheus text on a localhost port (0 = OS-assigned). Returns
+        the running :class:`~parallax_tpu.obs.export.TelemetryExporter`
+        (``.url`` has the endpoint); stopped automatically at
+        :meth:`close`."""
+        from parallax_tpu.obs.export import TelemetryExporter
+
+        if self._exporter is not None:
+            # never leak a bound port + serving thread on re-call
+            self._exporter.stop()
+
+        def snapshot():
+            out = {"fleet": self.metrics.snapshot()}
+            for rid, reg in list(self._registries.items()):
+                out[f"replica{rid}"] = reg.snapshot()
+            return out
+
+        self._exporter = TelemetryExporter(snapshot, port=port)
+        return self._exporter.start()
+
     def recompiles(self) -> int:
         """Total serve-time executable-table misses across every live
         replica — the fleet-wide zero-recompile invariant."""
@@ -727,6 +865,8 @@ class ServeFleet:
         if self._closed:
             return
         self._closed = True
+        if self._exporter is not None:
+            self._exporter.stop()
         self._stop.set()
         self._thread.join(timeout=10.0)
         for h in self._router.handles():

@@ -132,8 +132,9 @@ class ReplicaHandle:
     def placeable(self) -> bool:
         return self.state in (HEALTHY, DEGRADED) and not self.dead
 
-    def snapshot(self) -> Dict[str, Any]:
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
         rate = self.error_rate()
+        now = time.perf_counter() if now is None else now
         return {"state": self.state, "reason": self.state_reason,
                 "dead": self.dead,
                 "error_rate": round(rate, 3) if rate is not None else None,
@@ -141,7 +142,21 @@ class ReplicaHandle:
                                     if self.latency_ewma_ms is not None
                                     else None),
                 "ejections": self.ejections,
-                "load": self.session.load()}
+                "load": self.session.load(),
+                # circuit-breaker status (ISSUE 12): the incident dump
+                # must show whether a replica can come back, when, and
+                # what it still owes probation
+                "circuit": {
+                    "reopen_at": self.reopen_at,
+                    "reopen_in_s": (round(self.reopen_at - now, 3)
+                                    if self.reopen_at is not None
+                                    else None),
+                    "probation_left": self.probation_left,
+                    "last_error_at": self.last_error_at,
+                },
+                "placing": self.placing,
+                "heartbeat_age_s": round(
+                    now - self.session.heartbeat, 4)}
 
 
 class Router:

@@ -45,11 +45,12 @@ from parallax_tpu.common.config import ParallaxConfig
 from parallax_tpu.common.lib import parallax_log
 from parallax_tpu.compile import bucketing
 from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
-from parallax_tpu.obs import metrics as obs_metrics, trace
+from parallax_tpu.obs import _state as obs_state
+from parallax_tpu.obs import metrics as obs_metrics, reqtrace, trace
 from parallax_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
                                         ReplicaUnavailable, Request,
                                         RequestQueue, ServeClosed,
-                                        ServeError)
+                                        ServeError, ServeOverloaded)
 
 
 class ServeSession:
@@ -122,6 +123,13 @@ class ServeSession:
         self.replica_id = replica_id
         self._faults = faults
         self._check_outputs = bool(check_outputs)
+        # request forensics (ISSUE 12): the per-request lifecycle ring
+        # behind the serve.timeline.* / serve.slo.* gauges. Standalone
+        # sessions own their records; fleet sub-requests carry the
+        # FLEET's record through submit(rec=...) so a failed-over
+        # request keeps ONE decomposition across hops (and lands in
+        # the fleet's ring, not this one).
+        self.reqtrace = reqtrace.RequestTraceRing(self.metrics)
         self._queue = RequestQueue(sc.max_queue, self.metrics,
                                    on_timeout=self._on_deadline_breach)
         self._closed = False
@@ -301,7 +309,8 @@ class ServeSession:
 
     def submit(self, feed: Dict[str, Any],
                deadline_ms: Optional[float] = None,
-               max_new_tokens: Optional[int] = None) -> Request:
+               max_new_tokens: Optional[int] = None,
+               rec: Optional[reqtrace.RequestRecord] = None) -> Request:
         """Admit one request; returns its :class:`Request` future.
 
         Raises :class:`ServeOverloaded` when admission control sheds it
@@ -309,7 +318,12 @@ class ServeSession:
         deadline (``deadline_ms`` or ``ServeConfig.default_deadline_ms``)
         bounds QUEUE+SERVE time: an expired request is dropped with
         :class:`DeadlineExceeded` instead of served late.
+
+        ``rec`` is the fleet's lifecycle record when this submit is a
+        failover hop (the record accumulates across hops); standalone
+        submits get a fresh one (None with the obs layer disabled).
         """
+        t_sub = time.perf_counter()
         sc = self._config.serve_config
         if self._faults is not None:
             # chaos hook: an armed `saturate` fault sheds here, exactly
@@ -324,11 +338,36 @@ class ServeSession:
                                                max_new_tokens)
         else:
             req = self._make_one_shot_request(feed, deadline)
+        if rec is None and obs_state.enabled:
+            rec = reqtrace.RequestRecord(req.id, t0=t_sub,
+                                         deadline=deadline,
+                                         ring=self.reqtrace)
+        if rec is not None:
+            req.rec = rec
+            rec.note_hop(self.replica_id)
+            rec.mark("queue_wait")
         self._requests.inc()
-        self._queue.put(req)  # raises ServeOverloaded / ServeClosed
+        try:
+            self._queue.put(req)  # raises ServeOverloaded / ServeClosed
+        except ServeError as e:
+            if rec is not None:
+                # the refused placement never held the request: keep
+                # the hop trail consistent with the fleet's
+                # replicas-actually-placed-on list
+                rec.drop_hop()
+                # a replica-level shed is retryable at the fleet tier —
+                # only a standalone record finalizes here
+                rec.attempt_failed("shed" if isinstance(
+                    e, ServeOverloaded) else "closed")
+            raise
         if self._scheduler is not None:
             self._scheduler.kick()
         return req
+
+    def request_records(self, last: Optional[int] = None):
+        """Snapshots of recently completed request lifecycle records
+        (tools/serve_report.py reads these)."""
+        return self.reqtrace.records(last)
 
     def _make_one_shot_request(self, feed, deadline) -> Request:
         feed = {k: np.asarray(v) for k, v in feed.items()}
@@ -394,6 +433,11 @@ class ServeSession:
         requests = live
         if not requests:
             return
+        for r in requests:
+            if r.rec is not None:
+                # one-shot service phase: batch formation + H2D +
+                # device step + result split, ended by _complete/_fail
+                r.rec.mark("service", t_host0)
         n = len(requests)
         bucket = next(b for b in self._batch_buckets if b >= n)
         batch = {}
@@ -474,8 +518,11 @@ class ServeSession:
                           for a, s in zip(leaves, batched)]))
             delivered += 1
             self._latency.record((now - r.t_enqueue) * 1e3)
-            trace.record_span("serve.request", r.t_enqueue, now,
-                              id=r.id, batch=bucket)
+            trace.record_span(
+                "serve.request", r.t_enqueue, now, id=r.id,
+                batch=bucket, replica=self.replica_id,
+                rid=(r.rec.key if r.rec is not None else r.id),
+                hops=(len(r.rec.hops) if r.rec is not None else 1))
         if n_late:
             self._on_deadline_breach(n_late, where="service")
         self._completed.inc(delivered)

@@ -350,7 +350,8 @@ class ParallaxSession:
         self._metrics_sink = (
             JsonlSink(self.metrics, config.metrics_path,
                       config.metrics_interval_s,
-                      snapshot_fn=self.metrics_snapshot)
+                      snapshot_fn=self.metrics_snapshot,
+                      max_bytes=config.metrics_max_bytes)
             if config.metrics_path else None)
         self._last_dispatch_end: Optional[float] = None
         self._prefetcher = None

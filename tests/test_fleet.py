@@ -793,8 +793,15 @@ def test_decode_failover_token_identity_paged():
     dead replica's pages are simply abandoned with it and the retry
     allocates fresh ones on the survivor. Shared mesh (in-process
     multi-mesh caution); the subprocess chaos guard covers the
-    per-replica-submesh shape."""
+    per-replica-submesh shape.
+
+    ISSUE 12 satellite, same rig: cross-thread ``trace.record_span``
+    under failover — each logical request surfaces EXACTLY ONE
+    ``serve.request`` span (the dead hop never retires, so only the
+    delivering replica emits), carrying the final replica id and the
+    hop count."""
     from parallax_tpu.models import nmt
+    from parallax_tpu.obs import trace
     from tools import loadgen
 
     inj = FaultInjector()
@@ -802,6 +809,8 @@ def test_decode_failover_token_identity_paged():
         replicas=2, slots=2, T=8, Ts=6, model_dim=16, vocab=64,
         page_size=4, faults=inj, submesh=False)
     n = 8
+    col = trace.TraceCollector(capacity=4096)
+    prev = trace.set_collector(col)
     try:
         reqs = [fleet.submit(make_feed(i)) for i in range(n)]
         while sum(1 for r in reqs if r.done()) < 1:
@@ -816,6 +825,7 @@ def test_decode_failover_token_identity_paged():
         assert fleet.recompiles() == 0
     finally:
         fleet.close()
+        trace.set_collector(prev)
     for i, (r, out) in enumerate(zip(reqs, outs)):
         src = make_feed(i)["src"]
         ref = np.asarray(nmt.greedy_decode(
@@ -823,6 +833,64 @@ def test_decode_failover_token_identity_paged():
         if nmt.EOS_ID in ref:
             ref = ref[:ref.index(nmt.EOS_ID) + 1]
         assert list(out) == ref, (i, r.replicas, list(out), ref)
+    # the trace contract: one span per logical request, final replica
+    # id + hop count in-args (keyed by the fleet request id the shared
+    # lifecycle record carries across hops)
+    spans = {}
+    for ev in col.events():
+        if ev.name == "serve.request":
+            spans.setdefault(ev.args["rid"], []).append(ev)
+    for r in reqs:
+        assert len(spans.get(r.id, [])) == 1, \
+            (r.id, r.replicas, spans.get(r.id))
+        args = spans[r.id][0].args
+        assert args["replica"] == r.replicas[-1], (args, r.replicas)
+        assert args["hops"] == len(r.replicas), (args, r.replicas)
+    survivor_hops = {len(r.replicas) for r in retried}
+    assert survivor_hops == {2}
+
+
+def test_incident_dump_correlates_fleet_state(tmp_path):
+    """ISSUE 12: a replica crash produces ONE correlated artifact —
+    shared incident id, the crashed replica named, every affected
+    request id with its failover hop trail, router health +
+    circuit-breaker states, the in-flight request table and the
+    per-replica registries, all in the same JSON."""
+    import glob
+    import json as json_mod
+
+    from parallax_tpu.obs.flightrec import FlightRecorder
+
+    inj = FaultInjector()
+    flight = FlightRecorder(flight_dir=str(tmp_path))
+    fleet, _ = _mlp_fleet(faults=inj, flight=flight)
+    try:
+        inj.arm(0, "crash")
+        reqs = [fleet.submit(_feed(i)) for i in range(8)]
+        for r in reqs:
+            r.result(timeout=30.0)
+        retried = [r for r in reqs if len(r.replicas) > 1]
+        assert retried
+    finally:
+        fleet.close()
+    dumps = glob.glob(str(tmp_path / "flight_fleet_crash*.json"))
+    assert len(dumps) == 1
+    doc = json_mod.load(open(dumps[0]))
+    assert doc["incident_id"]
+    assert doc["detail"]["replica"] == 0
+    affected = {a["id"]: a["hops"]
+                for a in doc["detail"]["affected_requests"]}
+    for r in retried:
+        assert affected.get(r.id) == r.replicas, (r.id, affected)
+    # correlated sections: router health + circuit state, the
+    # in-flight table, fleet aggregates with per-replica serve.*
+    states = {row["rid"]: row for row in doc["router"]}
+    assert states[0]["state"] == EJECTED and states[0]["dead"]
+    assert "circuit" in states[0] and "heartbeat_age_s" in states[0]
+    assert isinstance(doc["requests_in_flight"], list)
+    assert doc["fleet"]["replicas"]["0"]["serve"]
+    # the fleet request records ride along for post-hoc attribution
+    assert isinstance(doc["request_records"], list)
 
 
 # -- the tier-1 chaos guard (subprocess driver) -----------------------------

@@ -234,6 +234,35 @@ class TestFlightRecorder:
         assert fr.trigger("c") is None
         assert len(fr.dump_paths) == 2
 
+    def test_suppressed_dumps_counted_per_class(self, tmp_path):
+        """ISSUE 12 satellite: a rate-limited trigger must leave a
+        countable trace per incident class — a 9th incident of a
+        class shows up in flightrec.suppressed.<class> instead of
+        vanishing without record."""
+        from parallax_tpu.obs.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        fr = FlightRecorder(flight_dir=str(tmp_path), registry=reg,
+                            max_dumps=2)
+        assert fr.trigger("nonfinite_loss:a") is not None
+        for _ in range(3):  # same class: suppressed, counted
+            assert fr.trigger("nonfinite_loss:b") is None
+        assert fr.trigger("serve_deadline_breach") is not None
+        assert fr.trigger("fleet_crash:r0") is None  # max_dumps cap
+        snap = reg.snapshot()
+        assert snap["flightrec.suppressed.nonfinite_loss"] == 3
+        assert snap["flightrec.suppressed.fleet_crash"] == 1
+        assert snap["flight.dumps_suppressed"] == 4  # aggregate kept
+        assert snap["flight.dumps"] == 2
+
+    def test_artifacts_carry_incident_ids(self, tmp_path):
+        fr = FlightRecorder(flight_dir=str(tmp_path))
+        p1 = fr.trigger("a")
+        p2 = fr.trigger("b")
+        id1 = json.load(open(p1))["incident_id"]
+        id2 = json.load(open(p2))["incident_id"]
+        assert id1 and id2 and id1 != id2
+        assert fr.last_incident_id == id2
+
 
 # -- straggler aggregation (obs/aggregate.py) ------------------------------
 

@@ -140,6 +140,49 @@ class TestMetricsRegistry:
         assert all(line["metrics"]["n"] == 3 for line in lines)
         assert all("ts" in line for line in lines)
 
+    def test_jsonl_sink_rotates_at_max_bytes(self, tmp_path):
+        """ISSUE 12 satellite: a size-bounded sink rotates the file to
+        <path>.1 instead of growing without bound (a long-lived
+        serving fleet must not fill the disk); every line in both
+        files stays parseable and on-disk usage is bounded by
+        ~2x max_bytes."""
+        reg = MetricsRegistry()
+        reg.counter("n").inc(1)
+        path = tmp_path / "metrics.jsonl"
+        line_len = len(json.dumps(
+            {"ts": time.time(), "metrics": reg.snapshot()})) + 1
+        max_bytes = 3 * line_len + line_len // 2
+        sink = JsonlSink(reg, str(path), interval_s=30.0,
+                         max_bytes=max_bytes)
+        try:
+            for _ in range(8):
+                sink._write_line()
+        finally:
+            sink.stop()
+        rotated = tmp_path / "metrics.jsonl.1"
+        assert rotated.exists(), "no rotation happened"
+        for p in (path, rotated):
+            assert p.stat().st_size <= max_bytes + line_len
+            for raw in p.read_text().splitlines():
+                assert json.loads(raw)["metrics"]["n"] == 1
+
+    def test_jsonl_sink_default_keeps_unbounded_growth(self, tmp_path):
+        reg = MetricsRegistry()
+        path = tmp_path / "m.jsonl"
+        sink = JsonlSink(reg, str(path), interval_s=30.0)
+        try:
+            for _ in range(5):
+                sink._write_line()
+        finally:
+            sink.stop()
+        assert not (tmp_path / "m.jsonl.1").exists()
+        assert len(path.read_text().splitlines()) == 6  # 5 + final
+
+    def test_jsonl_sink_rejects_bad_max_bytes(self, tmp_path):
+        with pytest.raises(ValueError, match="metrics_max_bytes"):
+            JsonlSink(MetricsRegistry(), str(tmp_path / "x"),
+                      max_bytes=0)
+
 
 # -- span tracing ----------------------------------------------------------
 
@@ -597,3 +640,26 @@ def test_obs_overhead_within_budget():
     assert last["timeline_rows_per_step"] >= 1, last
     assert last["anomaly_obs_per_step"] >= 1, last
     assert last["killswitch_clean"], last
+
+
+def test_serve_obs_overhead_within_budget():
+    """ISSUE 12 acceptance: the serving-path request trace — phase
+    marks, the TTFT-decomposition snapshot, the ring publish and the
+    serve.request span — stays within the same 2% budget (of request
+    service time), and with the killswitch thrown the request path
+    collects NOTHING: no record objects, no ring growth, no spans."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.check_obs_overhead import measure_serve
+    last = None
+    for _attempt in range(2):
+        result = measure_serve(n_requests=24, slots=4, T=8,
+                               model_dim=16)
+        last = result
+        if result["serve_overhead_frac"] <= 0.02:
+            break
+    assert last["serve_overhead_frac"] <= 0.02, last
+    assert last["serve_obs_us_per_request"] > 0
+    # the record phases were actually seen and priced
+    assert last["marks_per_request"] >= 3, last
+    assert last["serve_killswitch_clean"], last

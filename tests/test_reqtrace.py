@@ -1,0 +1,449 @@
+"""Serving forensics (ISSUE 12): per-request lifecycle records (phase
+decomposition that sums to client TTFT, failover accumulation, KV
+pages, hop trails), the bounded request ring with lazy serve.timeline.*
+/ serve.slo.* gauges and chrome lanes keyed by request id, the
+Prometheus telemetry exporter, and the percentile attribution report
+(tools/serve_report.py) including the 64-offered acceptance level."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from parallax_tpu import obs
+from parallax_tpu.obs.metrics import MetricsRegistry
+from parallax_tpu.obs.reqtrace import (PHASES, RequestRecord,
+                                       RequestTraceRing)
+
+
+# -- the phase state machine ------------------------------------------------
+
+
+class TestRequestRecord:
+    def test_phases_partition_the_wall_clock(self):
+        rec = RequestRecord(key=1, t0=100.0)
+        rec.mark("queue_wait", now=100.010)
+        rec.mark("prefill", now=100.030)
+        rec.mark("decode", now=100.050)
+        rec.complete(now=100.100)
+        assert rec.phases["admission"] == pytest.approx(10.0)
+        assert rec.phases["queue_wait"] == pytest.approx(20.0)
+        assert rec.phases["prefill"] == pytest.approx(20.0)
+        assert rec.phases["decode"] == pytest.approx(50.0)
+        assert rec.total_ms == pytest.approx(100.0)
+        # the partition property: phases sum to the full window
+        assert sum(rec.phases.values()) == pytest.approx(rec.total_ms)
+
+    def test_ttft_decomp_sums_to_ttft_exactly(self):
+        rec = RequestRecord(key=2, t0=0.0)
+        rec.mark("queue_wait", now=0.005)
+        rec.mark("prefill", now=0.020)
+        rec.mark("decode", now=0.030)
+        rec.first_token(now=0.045)      # mid-decode snapshot
+        rec.complete(now=0.090)
+        assert rec.ttft_ms == pytest.approx(45.0)
+        assert sum(rec.ttft_decomp.values()) \
+            == pytest.approx(rec.ttft_ms)
+        # the open decode phase's in-progress share is included
+        assert rec.ttft_decomp["decode_ms"] == pytest.approx(15.0)
+        # ...without having closed it: decode keeps accruing to done
+        assert rec.phases["decode"] == pytest.approx(60.0)
+
+    def test_failover_accumulates_one_record_across_hops(self):
+        rec = RequestRecord(key=3, t0=0.0, fleet_owned=True)
+        rec.note_hop(0)
+        rec.mark("queue_wait", now=0.010)
+        rec.mark("prefill", now=0.020)
+        # replica 0 dies mid-prefill: fleet-owned records stay OPEN
+        rec.attempt_failed("ReplicaUnavailable", now=0.030)
+        assert not rec.done
+        rec.mark("failover", now=0.030)
+        rec.note_retry()
+        rec.note_hop(1)
+        rec.mark("queue_wait", now=0.040)   # re-placed on replica 1
+        rec.mark("prefill", now=0.050)
+        rec.mark("decode", now=0.070)
+        rec.first_token(now=0.080)
+        rec.complete(now=0.100)
+        assert rec.hops == [0, 1]
+        assert rec.retries == 1
+        assert rec.phases["failover"] == pytest.approx(10.0)
+        # re-entered phases accumulate: 10ms + 10ms of queue_wait
+        assert rec.phases["queue_wait"] == pytest.approx(20.0)
+        assert sum(rec.ttft_decomp.values()) \
+            == pytest.approx(rec.ttft_ms) == pytest.approx(80.0)
+
+    def test_refused_placement_retracts_the_hop(self):
+        """A replica that sheds at admission never held the request:
+        the announced hop is retracted, keeping the trail consistent
+        with the fleet's replicas-actually-placed-on list (and the
+        incident dump's affected-set matching)."""
+        rec = RequestRecord(key=30, t0=0.0, fleet_owned=True)
+        rec.note_hop(0)
+        rec.drop_hop()          # replica 0 shed at queue.put
+        assert rec.hops == []
+        rec.drop_hop()          # empty trail: no-op, no IndexError
+        rec.note_hop(1)
+        rec.complete(now=0.010)
+        assert rec.hops == [1]
+
+    def test_standalone_attempt_failure_finalizes(self):
+        rec = RequestRecord(key=4, t0=0.0)
+        rec.mark("queue_wait", now=0.010)
+        rec.attempt_failed("ReplicaUnavailable", now=0.020)
+        assert rec.done and rec.outcome == "ReplicaUnavailable"
+
+    def test_completion_is_idempotent_first_wins(self):
+        rec = RequestRecord(key=5, t0=0.0)
+        rec.complete(now=0.010, outcome="completed")
+        rec.complete(now=0.500, outcome="failed:late")
+        assert rec.outcome == "completed"
+        assert rec.total_ms == pytest.approx(10.0)
+
+    def test_disabled_layer_records_nothing(self):
+        obs.disable()
+        try:
+            rec = RequestRecord(key=6, t0=0.0)
+            rec.mark("queue_wait", now=0.010)
+            rec.note_hop(0)
+            rec.first_token(now=0.020)
+            rec.complete(now=0.030)
+        finally:
+            obs.enable()
+        assert rec.phases == {} and rec.hops == []
+        assert rec.ttft_ms is None and not rec.done
+
+    def test_segments_bounded(self):
+        rec = RequestRecord(key=7, t0=0.0)
+        for i in range(500):
+            rec.mark("decode" if i % 2 else "prefill", now=i * 1e-3)
+        assert len(rec.segments) <= RequestRecord.MAX_SEGMENTS
+        # accumulation continues past the segment cap
+        assert rec.n_marks == 500
+
+    def test_missed_deadline_flag(self):
+        rec = RequestRecord(key=8, t0=0.0, deadline=0.050)
+        rec.complete(now=0.080)
+        assert rec.missed_deadline() is True
+        rec2 = RequestRecord(key=9, t0=0.0, deadline=0.050)
+        rec2.complete(now=0.010)
+        assert rec2.missed_deadline() is False
+        assert RequestRecord(key=10, t0=0.0).missed_deadline() is None
+
+
+# -- the ring + lazy gauges -------------------------------------------------
+
+
+def _completed_record(key, t0=0.0, queue=0.010, decode=0.040,
+                      deadline=None, outcome="completed"):
+    rec = RequestRecord(key=key, t0=t0, deadline=deadline)
+    rec.mark("queue_wait", now=t0 + 0.001)
+    rec.mark("decode", now=t0 + 0.001 + queue)
+    rec.first_token(now=t0 + 0.001 + queue + decode / 2)
+    rec.complete(now=t0 + 0.001 + queue + decode, outcome=outcome)
+    return rec
+
+
+class TestRequestTraceRing:
+    def test_gauges_sampled_lazily_at_snapshot(self):
+        reg = MetricsRegistry()
+        ring = RequestTraceRing(reg, capacity=8)
+        for i in range(4):
+            ring.add(_completed_record(i))
+        snap = reg.snapshot()
+        assert snap["serve.timeline.requests"] == 4
+        assert snap["serve.timeline.queue_wait_ms"]["count"] == 4
+        assert snap["serve.timeline.queue_wait_ms"]["mean"] \
+            == pytest.approx(10.0, rel=1e-3)
+        assert snap["serve.timeline.decode_ms"]["mean"] \
+            == pytest.approx(40.0, rel=1e-3)
+        assert snap["serve.timeline.ttft_ms"]["count"] == 4
+        # phases never entered read as None, not fabricated zeros
+        assert snap["serve.timeline.slot_wait_ms"] is None
+        json.loads(json.dumps(snap))  # JSON-ready end to end
+
+    def test_ring_bounded_lifetime_counted(self):
+        ring = RequestTraceRing(MetricsRegistry(), capacity=4)
+        for i in range(10):
+            ring.add(_completed_record(i))
+        assert ring.total == 10
+        recs = ring.records()
+        assert len(recs) == 4
+        assert recs[-1]["id"] == 9
+
+    def test_slo_burn_gauges(self):
+        reg = MetricsRegistry()
+        ring = RequestTraceRing(reg, capacity=32, slo_budget=0.01)
+        # 8 with deadlines: 2 missed -> miss rate 0.25, budget x25
+        for i in range(6):
+            ring.add(_completed_record(i, deadline=1.0))
+        for i in range(2):
+            ring.add(_completed_record(10 + i, deadline=0.001,
+                                       outcome="deadline_exceeded"))
+        shed = RequestRecord(key=99, t0=0.0)
+        shed.complete(now=0.001, outcome="shed")
+        ring.add(shed)
+        snap = reg.snapshot()
+        assert snap["serve.slo.deadline_miss_rate"] \
+            == pytest.approx(0.25)
+        assert snap["serve.slo.deadline_miss_budget_consumed"] \
+            == pytest.approx(25.0)
+        assert snap["serve.slo.shed_rate"] == pytest.approx(1 / 9,
+                                                           rel=1e-2)
+        assert snap["serve.slo.p99_deadline_margin_ms"] < 0  # missed
+
+    def test_chrome_lanes_keyed_by_request(self, tmp_path):
+        ring = RequestTraceRing(MetricsRegistry(), capacity=8)
+        ring.add(_completed_record("a"))
+        ring.add(_completed_record("b"))
+        path = tmp_path / "lanes" / "req.json"
+        ring.export_chrome_trace(str(path))
+        doc = json.loads(path.read_text())
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        # one labeled lane per request, phases as complete events
+        assert {m["args"]["name"] for m in metas} \
+            == {"req a (completed)", "req b (completed)"}
+        assert len({m["tid"] for m in metas}) == 2
+        lanes = {e["tid"] for e in xs}
+        assert lanes == {m["tid"] for m in metas}
+        assert {e["name"] for e in xs} \
+            <= {"admission", "queue_wait", "decode"}
+        assert all(e["args"]["request"] in ("a", "b") for e in xs)
+
+    def test_disabled_ring_collects_nothing(self):
+        ring = RequestTraceRing(MetricsRegistry(), capacity=8)
+        rec = _completed_record(0)   # completed while enabled
+        obs.disable()
+        try:
+            ring.add(rec)
+        finally:
+            obs.enable()
+        assert ring.total == 0
+
+
+# -- the telemetry exporter -------------------------------------------------
+
+
+class TestTelemetryExporter:
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            return resp.status, resp.headers.get("Content-Type"), \
+                resp.read().decode()
+
+    def test_prometheus_endpoint_renders_registries(self):
+        reg = MetricsRegistry()
+        reg.counter("serve.completed").inc(7)
+        reg.gauge("serve.queue_depth").set(3)
+        h = reg.histogram("serve.ttft_ms")
+        for v in (10.0, 20.0, 30.0):
+            h.record(v)
+        ring = RequestTraceRing(reg, capacity=8)
+        ring.add(_completed_record(0, deadline=1.0))
+        exporter = obs.TelemetryExporter(
+            lambda: {"fleet": reg.snapshot()})
+        try:
+            exporter.start()
+            status, ctype, body = self._get(exporter.url)
+        finally:
+            exporter.stop()
+        assert status == 200 and "text/plain" in ctype
+        assert 'parallax_serve_completed{source="fleet"} 7.0' in body
+        assert 'parallax_serve_queue_depth{source="fleet"} 3.0' in body
+        # histograms expand to _count/_mean/_max + quantile samples
+        assert 'parallax_serve_ttft_ms_count{source="fleet"} 3.0' \
+            in body
+        assert ('parallax_serve_ttft_ms{source="fleet",'
+                'quantile="0.5"} 20.0') in body
+        # the lazy request-timeline and SLO burn gauges ride along
+        assert "parallax_serve_timeline_decode_ms_mean" in body
+        assert "parallax_serve_slo_deadline_miss_rate" in body
+
+    def test_healthz_and_unknown_path(self):
+        exporter = obs.TelemetryExporter(lambda: {"": {}})
+        try:
+            exporter.start()
+            base = exporter.url.rsplit("/", 1)[0]
+            status, _, body = self._get(base + "/healthz")
+            assert status == 200 and json.loads(body) == {"ok": True}
+            with pytest.raises(urllib.error.HTTPError):
+                self._get(base + "/nope")
+        finally:
+            exporter.stop()
+        exporter.stop()  # idempotent
+
+    def test_broken_snapshot_returns_500_not_crash(self):
+        def boom():
+            raise RuntimeError("poisoned registry")
+        exporter = obs.TelemetryExporter(boom)
+        try:
+            exporter.start()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(exporter.url)
+            assert ei.value.code == 500
+            # the server survives a failed scrape
+            with pytest.raises(urllib.error.HTTPError):
+                self._get(exporter.url)
+        finally:
+            exporter.stop()
+
+
+# -- live serving integration -----------------------------------------------
+
+
+class TestServeIntegration:
+    @pytest.fixture(scope="class")
+    def decode_session(self):
+        from tools import loadgen
+        sess, make_feed = loadgen.demo_decode_session(
+            slots=4, T=8, Ts=6, model_dim=16, vocab=64,
+            speculative=False, prefill_chunk_layers=None)
+        yield sess, make_feed
+        sess.close()
+
+    def test_records_decompose_ttft_and_name_pages(self,
+                                                   decode_session):
+        sess, make_feed = decode_session
+        reqs = [sess.submit(make_feed(i)) for i in range(6)]
+        for r in reqs:
+            r.result(timeout=60.0)
+        recs = {r["id"]: r for r in sess.request_records()}
+        for req in reqs:
+            rec = recs[req.id]
+            assert rec["outcome"] == "completed"
+            for phase in ("admission_ms", "queue_wait_ms",
+                          "prefill_ms", "decode_ms"):
+                assert phase in rec["phases_ms"], rec
+            # paged program: pages held are on the record
+            assert rec["kv_pages"] >= 1
+            assert rec["decode_steps"] == rec["tokens"] > 0
+            # the acceptance property: decomposition sums to the
+            # client-side TTFT
+            client_ttft_ms = (req.t_first_token - req.t_enqueue) * 1e3
+            # snapshot values are rounded to 4 decimals; the raw sum
+            # is exact by construction
+            assert sum(rec["ttft_decomp"].values()) \
+                == pytest.approx(rec["ttft_ms"], abs=0.01)
+            assert rec["ttft_ms"] == pytest.approx(client_ttft_ms,
+                                                   rel=0.05)
+        snap = sess.metrics.snapshot()
+        assert snap["serve.timeline.ttft_ms"]["count"] >= 6
+        assert snap["serve.timeline.requests"] >= 6
+
+    def test_deadline_expiry_lands_in_slo_gauges(self, decode_session):
+        sess, make_feed = decode_session
+        req = sess.submit(make_feed(0), deadline_ms=0.01)
+        with pytest.raises(Exception):
+            req.result(timeout=60.0)
+        # wait for the scheduler to process the expiry
+        end = time.perf_counter() + 10.0
+        while time.perf_counter() < end:
+            recs = sess.request_records()
+            if any(r["outcome"] == "deadline_exceeded" for r in recs):
+                break
+            time.sleep(0.01)
+        snap = sess.metrics.snapshot()
+        assert snap["serve.slo.deadline_miss_rate"] > 0
+        assert snap["serve.slo.deadline_miss_budget_consumed"] > 0
+
+
+# -- the attribution report (tools/serve_report.py) -------------------------
+
+
+class TestServeReport:
+    @staticmethod
+    def _fake(ttft, queue, decode, total=None):
+        return {"ttft_ms": ttft, "total_ms": total or ttft + 10.0,
+                "ttft_decomp": {"queue_wait_ms": queue,
+                                "decode_ms": decode}}
+
+    def test_analyze_names_dominant_cause_per_bucket(self):
+        from tools import serve_report
+        records = (
+            # typical half: decode-bound
+            [self._fake(10.0, 2.0, 8.0) for _ in range(50)]
+            # the tail: queue-bound (the story p99 must tell)
+            + [self._fake(100.0 + i, 90.0 + i, 10.0)
+               for i in range(10)])
+        report = serve_report.analyze(records)
+        assert report["requests_analyzed"] == 60
+        assert report["buckets"]["p50"]["dominant"] == "decode"
+        assert report["dominant_p99"] == "queue_wait"
+        assert report["buckets"]["p99"]["ttft_ms"] >= 100.0
+        assert "queue_wait" in serve_report.headline(report, 64)
+
+    def test_shares_and_budget_helpers(self):
+        from tools import serve_report
+        records = [self._fake(10.0, 5.0, 5.0)]
+        shares = serve_report.ttft_shares(records)
+        assert shares == {"decode_share": 0.5, "queue_wait_share": 0.5}
+        assert serve_report.ttft_shares([]) is None
+        with_ddl = [dict(self._fake(10.0, 5.0, 5.0), deadline_ms=5.0),
+                    dict(self._fake(10.0, 5.0, 5.0), deadline_ms=500.0)]
+        assert serve_report.deadline_miss_budget_consumed(
+            with_ddl, budget=0.01) == pytest.approx(50.0)
+        assert serve_report.deadline_miss_budget_consumed([]) is None
+
+    def test_64_offered_level_names_a_p99_cause(self):
+        """Acceptance (ISSUE 12): the serve report at the 64-offered
+        sweep level names a dominant p99 cause (small-model rig keeps
+        this tier-1-affordable; the phase label is workload-dependent,
+        so what is asserted is that ONE valid phase is named with
+        self-consistent shares)."""
+        from tools import serve_report
+        out = serve_report.measure(level=64, requests=96, T=8,
+                                   model_dim=16, vocab=64)
+        assert out["completed"] == 96
+        report = out["report"]
+        assert report["dominant_p99"] in PHASES
+        p99 = report["buckets"]["p99"]
+        assert p99["count"] >= 1 and p99["ttft_ms"] > 0
+        assert sum(p99["shares"].values()) == pytest.approx(1.0,
+                                                            abs=0.01)
+        assert "p99 is" in out["headline"] and "64" in out["headline"]
+        assert out["ttft_decomp"]
+
+
+# -- the fleet exporter convenience -----------------------------------------
+
+
+def test_fleet_start_exporter_aggregates_replicas():
+    from parallax_tpu.serve import FleetConfig, ServeFleet
+
+    class _FakeSession:
+        alive = True
+
+        def __init__(self):
+            self.heartbeat = time.perf_counter()
+
+        def load(self):
+            return 0.0
+
+        def idle(self):
+            return True
+
+        def close(self, drain=True):
+            pass
+
+    def make_replica(rid, **kw):
+        # a real ServeSession fills its registry; the fake seeds one
+        # counter so the per-replica source labels are observable
+        kw["metrics"].counter("serve.completed").inc(1)
+        return _FakeSession()
+
+    fleet = ServeFleet(make_replica,
+                       config=FleetConfig(num_replicas=2,
+                                          tick_interval_s=3600.0))
+    try:
+        exporter = fleet.start_exporter()
+        with urllib.request.urlopen(exporter.url, timeout=10.0) as r:
+            body = r.read().decode()
+        assert 'parallax_fleet_replicas{source="fleet"} 2.0' in body
+        # per-replica registries are source-labeled in the same scrape
+        assert 'source="replica0"' in body
+        assert 'source="replica1"' in body
+    finally:
+        fleet.close()
+    assert fleet._exporter._server is None  # stopped at close

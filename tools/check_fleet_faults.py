@@ -185,6 +185,53 @@ def measure(n_requests: int = 20, slots: int = 4, T: int = 12,
     crash_artifacts = [p for p in flight.dump_paths
                       if "fleet_crash" in os.path.basename(p)]
     result["crash"]["flight_artifacts"] = crash_artifacts
+    # request forensics (ISSUE 12): every completed request's TTFT
+    # decomposition must sum to its measured client-side TTFT (the
+    # phase machine partitions the client window by construction, so
+    # a drift here means a phase is being dropped or double-counted)
+    ttft_errs = []
+    for r in reqs:
+        rec = getattr(r, "rec", None)
+        if rec is None or r.t_first_token is None \
+                or rec.ttft_decomp is None:
+            continue
+        client_ttft_ms = (r.t_first_token - r.t_enqueue) * 1e3
+        decomp_sum = sum(rec.ttft_decomp.values())
+        if client_ttft_ms > 0:
+            ttft_errs.append(abs(decomp_sum - client_ttft_ms)
+                             / client_ttft_ms)
+    result["crash"]["ttft_decomp_checked"] = len(ttft_errs)
+    result["crash"]["ttft_decomp_max_rel_err"] = (
+        round(max(ttft_errs), 5) if ttft_errs else None)
+    # the correlated incident artifact: ONE dump that names the
+    # crashed replica, stamps a shared incident id, captures router
+    # health + circuit-breaker states and the in-flight table, and
+    # lists every affected request with its failover hop trail
+    incident = {}
+    if crash_artifacts:
+        with open(crash_artifacts[0]) as f:
+            doc = json.load(f)
+        det = doc.get("detail") or {}
+        affected = det.get("affected_requests") or []
+        by_id = {a.get("id"): a.get("hops") or [] for a in affected}
+        retried_ids = [r.id for r in reqs if len(r.replicas) > 1]
+        incident = {
+            "incident_id": doc.get("incident_id"),
+            "replica_named": det.get("replica"),
+            "affected_count": len(affected),
+            "affected_sample": affected[:5],
+            "has_router_section": isinstance(doc.get("router"), list),
+            "has_inflight_table": isinstance(
+                doc.get("requests_in_flight"), list),
+            "has_fleet_section": isinstance(doc.get("fleet"), dict),
+            "retried_ids": retried_ids,
+            "retried_ids_covered": all(
+                rid_ in by_id
+                and victim.rid in by_id[rid_]
+                and len(by_id[rid_]) > 1
+                for rid_ in retried_ids),
+        }
+    result["crash"]["incident"] = incident
 
     # -- phase 3: mid-traffic weight hot-swap --------------------------
     flight2 = FlightRecorder(flight_dir=flight_dir)
@@ -269,6 +316,10 @@ def measure(n_requests: int = 20, slots: int = 4, T: int = 12,
         "recompiles": c["recompiles"] + h["recompiles"],
         "token_mismatches": (c["token_mismatch_count"]
                              + h["token_mismatch_count"]),
+        "incident_correlated": bool(
+            c.get("incident", {}).get("incident_id")
+            and c["incident"].get("retried_ids_covered")),
+        "ttft_decomp_max_rel_err": c.get("ttft_decomp_max_rel_err"),
     }
     return result
 
@@ -321,6 +372,34 @@ def check(result: dict) -> list:
     if not c["flight_artifacts"]:
         bad.append("no flight-recorder artifact names the fleet_crash "
                    "incident")
+    inc = c.get("incident") or {}
+    if c["flight_artifacts"]:
+        if not inc.get("incident_id"):
+            bad.append("fleet_crash artifact carries no incident_id")
+        if inc.get("replica_named") != c["victim_replica"]:
+            bad.append(
+                f"fleet_crash artifact names replica "
+                f"{inc.get('replica_named')!r}, not the crashed "
+                f"{c['victim_replica']!r}")
+        if not inc.get("retried_ids_covered"):
+            bad.append(
+                f"fleet_crash artifact's affected_requests does not "
+                f"cover every failed-over request with its hop trail "
+                f"(retried={inc.get('retried_ids')}, "
+                f"affected={inc.get('affected_sample')})")
+        for section in ("has_router_section", "has_inflight_table",
+                        "has_fleet_section"):
+            if not inc.get(section):
+                bad.append(f"fleet_crash artifact missing correlated "
+                           f"section: {section[4:]}")
+    if not c.get("ttft_decomp_checked"):
+        bad.append("no per-request TTFT decompositions were available "
+                   "to verify")
+    elif c["ttft_decomp_max_rel_err"] > 0.05:
+        bad.append(
+            f"per-request TTFT decomposition drifts "
+            f"{c['ttft_decomp_max_rel_err'] * 100:.2f}% from the "
+            f"measured client-side TTFT (> 5%)")
     h = result["hotswap"]
     if h["dropped"]:
         bad.append(f"hot-swap phase dropped {h['dropped']} accepted "

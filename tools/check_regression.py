@@ -195,6 +195,14 @@ SECONDARY_GATES = (
     ("decode.rows.-1.cached_ms", False),
     ("decode.spec_vs_plain.tokens_per_sec_spec", True),
     ("decode.paged_vs_dense.paged_step_ms", False),
+    # p99 attribution (ISSUE 12, tools/serve_report via the sweep's
+    # 64-offered row): the tail latency the request-trace layer
+    # decomposes must not quietly regress — both the p99 TTFT and the
+    # p99 total latency of the attribution report are gated (the
+    # dominant-cause LABEL is diagnostic, not gateable; missing-on-
+    # either-side keys skip, per the established convention)
+    ("serve.continuous.report.buckets.p99.ttft_ms", False),
+    ("serve.continuous.report.buckets.p99.total_ms", False),
     # fleet robustness latencies (ISSUE 7, tools/check_fleet_faults):
     # how long a crash's failed-over requests take to land on healthy
     # replicas, and the longest fleet-wide completion gap during a

@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import os
 import sys
 import threading
@@ -38,10 +37,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def _pct(sorted_ms, q):
-    if not sorted_ms:
-        return None
-    return round(sorted_ms[min(len(sorted_ms) - 1,
-                               math.ceil(q * len(sorted_ms)) - 1)], 3)
+    # one quantile rule repo-wide (obs/metrics.nearest_rank)
+    from parallax_tpu.obs.metrics import nearest_rank
+    v = nearest_rank(sorted_ms, q)
+    return round(v, 3) if v is not None else None
 
 
 def run_load(session, make_feed, n_requests: int, concurrency: int = 4,
@@ -322,6 +321,8 @@ def sweep_decode(levels=(8, 16, 32, 64), requests_per_level=None,
     and TTFT stamped per level. Sessions are rebuilt per level so
     every row starts from a cold queue and clean metrics; warmup
     compiles happen at construction, OUTSIDE the measured window."""
+    from tools import serve_report
+
     rows = []
     for level in levels:
         n_req = requests_per_level or max(2 * level, 16)
@@ -330,8 +331,12 @@ def sweep_decode(levels=(8, 16, 32, 64), requests_per_level=None,
             rep = run_load(sess, make_feed, n_req, concurrency=level,
                            result_timeout_s=result_timeout_s)
             stats = sess.stats()
+            records = sess.request_records()
         finally:
             sess.close()
+        # trace-derived attribution (ISSUE 12): per-phase TTFT shares
+        # and the per-percentile dominant-cause report for this level
+        attribution = serve_report.analyze(records)
         rows.append({
             "offered_concurrency": level,
             "slots": level,
@@ -349,6 +354,10 @@ def sweep_decode(levels=(8, 16, 32, 64), requests_per_level=None,
                                             0),
             "spec_accept_rate": stats.get("serve.spec_accept_rate"),
             "decode_steps": stats.get("serve.decode_steps"),
+            "ttft_decomp": serve_report.ttft_shares(records),
+            "deadline_miss_budget_consumed":
+                serve_report.deadline_miss_budget_consumed(records),
+            "attribution": attribution,
         })
         print(f"# sweep level {level}: {rep['tokens_per_sec']} tok/s, "
               f"ttft p50 {rep['ttft_ms']['p50']}ms", flush=True)
