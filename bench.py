@@ -617,6 +617,43 @@ def worker_main():
             print(f"# tune bench failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
 
+    # Plan-observatory block (ISSUE 13): one profiled window end to
+    # end on the embedding rig — measured per-op attribution shares,
+    # coverage vs the device step wall with the residual explicit,
+    # and the per-term calibration ratios (predicted/measured for the
+    # on-chip and wire roofline terms). tools/check_regression.py
+    # secondary-gates profile.attribution_coverage and (two-sided)
+    # the wire calibration drift — the ratio is CPU-relative off-TPU,
+    # so cross-round DRIFT is the gated signal, never the absolute.
+    # Subprocess child (tools/check_profile_attrib.py — the same
+    # tier-1 guard): jax.profiler capture is process-global state an
+    # abort must not leak into the headline. PARALLAX_BENCH_PROFILE=0
+    # skips. No BENCH_VERSION bump: new block, gate-side skip.
+    profile_snap = None
+    if os.environ.get("PARALLAX_BENCH_PROFILE", "1") != "0":
+        try:
+            here = os.path.dirname(os.path.abspath(__file__))
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(here, "tools",
+                              "check_profile_attrib.py")],
+                env=dict(os.environ), capture_output=True, text=True,
+                timeout=600)
+            start = proc.stdout.find("{")
+            if start >= 0:
+                profile_snap = json.loads(proc.stdout[start:])
+                if proc.returncode != 0:
+                    print(f"# profile guard violations: "
+                          f"{profile_snap.get('violations')}",
+                          flush=True)
+            else:
+                print(f"# profile bench child failed rc="
+                      f"{proc.returncode}: "
+                      f"{(proc.stderr or '')[-200:]}", flush=True)
+        except Exception as e:
+            print(f"# profile bench failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
     # Checkpoint cost block (ISSUE 9): save/restore latency, bytes,
     # and the async-save step-overhead A/B (async critical-path cost
     # vs the synchronous path, amortized over the save cadence —
@@ -736,6 +773,11 @@ def worker_main():
         # enumerated/pruned/trialed, winner predicted-vs-measured ms
         # (CPU-relative off-TPU), search wall seconds, cache hits
         "tune": tune_snap,
+        # plan observatory (ISSUE 13): measured per-op attribution of
+        # one profiled window (coverage vs device step wall, residual
+        # explicit, category shares, dense/sparse split) + per-term
+        # cost-model calibration ratios (CPU-relative off-TPU)
+        "profile": profile_snap,
         # same-round A/B under the previous round's harness params,
         # recorded iff bench_version bumped this round (VERDICT r5
         # item 6); tools/check_regression.py requires it to treat a

@@ -303,6 +303,15 @@ class TuneConfig:
       the model resolves the chip's published peak where known and
       otherwise falls back to nominal TPU-class constants — rankings
       stay meaningful, absolute predictions are CPU-relative.
+    * ``hbm_budget_gb`` / ``hbm_headroom``: the OOM preflight
+      (``obs/memwatch.py``, ISSUE 13). Any shortlisted plan whose
+      compiled ``memory_analysis()`` peak exceeds
+      ``budget x headroom`` is REFUSED before paying a measured
+      trial, recorded in the decision record like
+      ``pruned_equivalent``. ``hbm_budget_gb`` unset resolves the
+      budget from the smallest ``bytes_limit`` a local device
+      reports; backends reporting neither (the CPU rig) skip the
+      preflight — refusal requires evidence, never a guess.
     """
 
     enabled: bool = True
@@ -315,6 +324,8 @@ class TuneConfig:
     peak_flops: Optional[float] = None
     hbm_gbps: Optional[float] = None
     ici_gbps: Optional[float] = None
+    hbm_budget_gb: Optional[float] = None
+    hbm_headroom: float = 0.9
 
     def __post_init__(self):
         if int(self.top_k) < 1:
@@ -345,11 +356,16 @@ class TuneConfig:
                 f"tune trial_steps ({self.trial_steps}) must exceed "
                 f"trial_warmup ({self.trial_warmup}); the measured "
                 f"window would be empty")
-        for name in ("peak_flops", "hbm_gbps", "ici_gbps"):
+        for name in ("peak_flops", "hbm_gbps", "ici_gbps",
+                     "hbm_budget_gb"):
             v = getattr(self, name)
             if v is not None and float(v) <= 0:
                 raise ValueError(
                     f"tune {name} must be > 0 when set, got {v}")
+        if not (0.0 < float(self.hbm_headroom) <= 1.0):
+            raise ValueError(
+                f"tune hbm_headroom must be in (0, 1], got "
+                f"{self.hbm_headroom}")
 
 
 @dataclasses.dataclass
@@ -624,6 +640,14 @@ class ParallaxConfig:
     # the full plan space is priced analytically and only the top_k
     # shortlist pays measured trials. See the TuneConfig docstring.
     tune_config: Optional["TuneConfig"] = None
+    # Cost-model calibration file (tune/calibrate.py, ISSUE 13): when
+    # set and readable, the cost model divides each roofline term by
+    # the file's measured predicted/measured ratio instead of trusting
+    # nominal constants; session.write_calibration() creates/refreshes
+    # it from a profiled window (session.profile_steps). Missing or
+    # corrupt files fall back to nominal, loudly. The ratios are
+    # rig-relative — do not ship a CPU-made file to a TPU pod.
+    calibration_path: Optional[str] = None
 
     # Injected by parallel_run, mirroring the reference's set_sync /
     # set_resource_info setters (config.py:168-179).

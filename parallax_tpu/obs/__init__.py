@@ -45,6 +45,18 @@ The serving forensics layer (ISSUE 12) extends it to the request path:
   * :mod:`~parallax_tpu.obs.export` — live Prometheus-text telemetry
     over localhost HTTP (fleet aggregates + per-replica registries).
 
+The plan observatory (ISSUE 13) adds the measured device-side view:
+
+  * :mod:`~parallax_tpu.obs.xprof` — windowed ``jax.profiler``
+    captures (``session.profile_steps``) parsed into per-op /
+    per-collective attribution with the unattributed residual
+    explicit, HLO-metadata layer + dense-sparse joins, lazy
+    ``profile.*`` gauges.
+  * :mod:`~parallax_tpu.obs.memwatch` — compiled
+    ``memory_analysis()`` peaks, a bounded live-HBM ring with
+    per-device gauges and the ``oom_risk`` incident, and the budget
+    resolution behind the tuner's OOM preflight.
+
 ``disable()`` / ``enable()`` (or env ``PARALLAX_OBS=0``) switch the
 whole layer to near-free no-ops process-wide;
 `tools/check_obs_overhead.py` holds the enabled path to <=2% of step
@@ -53,8 +65,9 @@ wall-time.
 
 from parallax_tpu.obs._state import disable, enable, is_enabled
 from parallax_tpu.obs import (aggregate, anomaly, export, flightrec,
-                              health, metrics, reqtrace, timeline,
-                              trace)
+                              health, memwatch, metrics, reqtrace,
+                              timeline, trace, xprof)
+from parallax_tpu.obs.memwatch import MemWatch
 from parallax_tpu.obs.aggregate import (aggregate_host_step_times,
                                         find_stragglers)
 from parallax_tpu.obs.anomaly import AnomalyEvent, AnomalyMonitor
@@ -71,7 +84,8 @@ from parallax_tpu.obs.trace import (TraceCollector, TraceEvent,
 
 __all__ = [
     "trace", "metrics", "health", "timeline", "flightrec", "anomaly",
-    "aggregate", "reqtrace", "export", "span", "TraceCollector",
+    "aggregate", "reqtrace", "export", "xprof", "memwatch",
+    "MemWatch", "span", "TraceCollector",
     "TraceEvent", "export_chrome_trace", "MetricsRegistry", "Counter",
     "Gauge", "Histogram", "JsonlSink", "PipelineStats", "HealthMonitor",
     "device_memory_stats", "StepTimeline", "FlightRecorder",

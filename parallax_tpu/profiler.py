@@ -32,13 +32,67 @@ class ProfileHook:
         self._config = config or ProfileConfig()
         self._worker_id = worker_id
         self._tracing = False
-        enabled_worker = (self._config.profile_worker is None
-                          or self._config.profile_worker == worker_id)
-        self._enabled = bool(self._config.profile_dir) and enabled_worker
+        # worker gating is shared by the config-driven windows AND the
+        # on-demand ones (session.profile_steps): a pod must not write
+        # N identical traces however the capture was requested
+        self._worker_ok = (self._config.profile_worker is None
+                           or self._config.profile_worker == worker_id)
+        self._enabled = bool(self._config.profile_dir) and self._worker_ok
+        # on-demand capture window (ISSUE 13): (begin, end, outdir),
+        # armed by request_window; cleared once its capture stops
+        self._window = None
+        # fn(trace_dir, steps_captured) called after ANY stop_trace —
+        # the session hangs the xprof attribution off it
+        self._on_stop = None
+        self._active_dir: Optional[str] = None
+        self._begin_step = 0
 
     @property
     def active(self) -> bool:
         return self._tracing
+
+    @property
+    def worker_enabled(self) -> bool:
+        """Whether this worker's gating admits captures at all —
+        check BEFORE allocating capture directories."""
+        return self._worker_ok
+
+    @property
+    def capture_busy(self) -> bool:
+        """A capture is armed or in flight; request_window would
+        refuse."""
+        return self._tracing or self._window is not None
+
+    def set_on_stop(self, fn) -> None:
+        """Install the capture-complete callback
+        (``fn(trace_dir, steps_captured)``); fired after every
+        ``stop_trace``, config-driven and on-demand alike, and always
+        guarded — attribution failing must never kill the step
+        loop."""
+        self._on_stop = fn
+
+    def request_window(self, start_step: int, n: int,
+                       outdir: str) -> bool:
+        """Arm an on-demand capture of steps ``[start_step,
+        start_step + n)`` into ``outdir`` (no ``profile_dir``
+        required). Returns False on a worker this hook's gating
+        excludes; refuses while a capture is in flight."""
+        if not self._worker_ok:
+            return False
+        if self._tracing or self._window is not None:
+            raise RuntimeError(
+                "a profile capture is already armed/in flight; wait "
+                "for it to finish before requesting another window")
+        if int(n) < 1:
+            raise ValueError(f"profile window must cover >= 1 step, "
+                             f"got {n}")
+        self._window = (int(start_step), int(start_step) + int(n),
+                        outdir)
+        return True
+
+    def _window_covers(self, step: int) -> bool:
+        return (self._window is not None
+                and self._window[0] <= step < self._window[1])
 
     def _is_profile_step(self, step: int) -> bool:
         cfg = self._config
@@ -69,23 +123,50 @@ class ProfileHook:
                     f"devices:{jax.local_device_count()} dir:{path}\n")
 
     def before_step(self, step: int) -> None:
-        if not self._enabled or self._tracing:
+        if self._tracing:
             return
-        if self._is_profile_step(step):
-            path = self._trace_dir()
-            os.makedirs(path, exist_ok=True)
+        dyn = self._window_covers(step)
+        if self._window is not None and not dyn \
+                and step >= self._window[1]:
+            # the run jumped past an armed window (skip/rollback):
+            # drop it rather than capture the wrong steps forever
+            parallax_log.warning(
+                "profile window %s expired unstarted at step %d",
+                self._window[:2], step)
+            self._window = None
+        cfg_hit = self._enabled and self._is_profile_step(step)
+        if not (dyn or cfg_hit):
+            return
+        path = self._window[2] if dyn else self._trace_dir()
+        os.makedirs(path, exist_ok=True)
+        if not dyn:
             self._append_task_info(path)
-            jax.profiler.start_trace(path)
-            self._tracing = True
-            parallax_log.info("profiling step %d -> %s", step, path)
+        jax.profiler.start_trace(path)
+        self._tracing = True
+        self._active_dir = path
+        self._begin_step = step
+        parallax_log.info("profiling step %d -> %s", step, path)
 
     def after_step(self, step: int) -> None:
         if not self._tracing:
             return
-        # Stop unless the *next* step is also inside a profile range.
-        if not self._is_profile_step(step + 1):
-            jax.profiler.stop_trace()
-            self._tracing = False
+        # Stop unless the *next* step is also inside a profile range
+        # (config-driven or on-demand).
+        if self._window_covers(step + 1) \
+                or (self._enabled and self._is_profile_step(step + 1)):
+            return
+        jax.profiler.stop_trace()
+        self._tracing = False
+        path, begin = self._active_dir, self._begin_step
+        self._active_dir = None
+        if self._window is not None and step >= self._window[1] - 1:
+            self._window = None
+        if self._on_stop is not None:
+            try:
+                self._on_stop(path, step + 1 - begin)
+            except Exception as e:  # attribution must never kill a run
+                parallax_log.warning(
+                    "profile on_stop callback failed: %s", e)
 
     def close(self) -> None:
         """Stop an in-flight trace. A profile_range extending past the
@@ -94,6 +175,7 @@ class ProfileHook:
         and a later start_trace raises. Called by
         ParallaxSession.close(); idempotent."""
         if not self._tracing:
+            self._window = None
             return
         try:
             jax.profiler.stop_trace()
@@ -107,3 +189,5 @@ class ProfileHook:
         # can't succeed, and the flag must not wedge close() into
         # repeating it
         self._tracing = False
+        self._window = None
+        self._active_dir = None

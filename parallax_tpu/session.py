@@ -54,7 +54,8 @@ from parallax_tpu.compile import bucketing as bucketing_lib, \
 from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
 from parallax_tpu.ckpt import CheckpointHook, RecoveryPolicy, \
     RecoverySurrender
-from parallax_tpu.obs import aggregate as aggregate_lib, trace
+from parallax_tpu.obs import aggregate as aggregate_lib, \
+    memwatch as memwatch_lib, trace, xprof
 from parallax_tpu.obs.anomaly import AnomalyMonitor
 from parallax_tpu.obs.flightrec import FlightRecorder
 from parallax_tpu.obs.health import HealthMonitor, device_memory_stats
@@ -63,7 +64,8 @@ from parallax_tpu.obs.metrics import (JsonlSink, MetricsRegistry,
 from parallax_tpu.obs.timeline import StepTimeline
 from parallax_tpu.profiler import ProfileHook
 from parallax_tpu.parallel.partitions import PartitionSearch
-from parallax_tpu.tune import costmodel as tune_costmodel
+from parallax_tpu.tune import calibrate as calibrate_lib, \
+    costmodel as tune_costmodel
 from parallax_tpu.tune.costmodel import Plan
 from parallax_tpu.tune.search import MeshSearch
 
@@ -278,6 +280,14 @@ class ParallaxSession:
                                       self._default_plan())
         self._step_times: List[float] = []
         self._profile = ProfileHook(config.profile_config, worker_id)
+        # -- plan observatory (obs/xprof, ISSUE 13) --------------------
+        # every capture the hook stops — config-driven or on-demand
+        # (profile_steps) — lands here as a pending trace, parsed
+        # LAZILY at the first profile_summary()/gauge read (a
+        # multi-MB JSON parse must not ride the dispatch thread)
+        self._profile.set_on_stop(self._on_profile_stop)
+        self._profile_pending: Optional[tuple] = None
+        self._profile_attrib: Optional[Dict[str, Any]] = None
         self._last_outputs: Dict[str, Any] = {}
         # Host-side mirror of state.step: reading the device value every
         # run() would block on the previous step and kill async dispatch.
@@ -342,7 +352,17 @@ class ParallaxSession:
                              if self._recovery is not None
                              else lambda: None),
                 "tune": lambda: self._tune_result,
+                "profile": self._profile_for_dump,
             })
+        # -- HBM watch (obs/memwatch, ISSUE 13): live-HBM ring sampled
+        # post-dispatch, per-device gauges the exporter serves, the
+        # oom_risk incident class, and the compiled-peak account the
+        # tuner's OOM preflight shares
+        self.memwatch = memwatch_lib.MemWatch(
+            self.metrics, flight=self.flight,
+            capacity=config.flight_steps)
+        self.flight.add_provider("memwatch", self.memwatch.stats)
+        self._register_profile_gauges()
         self.health = (HealthMonitor(
             self.metrics, on_nonfinite=self._on_nonfinite,
             on_reading=self._on_health_reading)
@@ -469,24 +489,7 @@ class ParallaxSession:
             plan = plan_or_partitions.validate_for(jax.device_count())
         else:
             plan = self._default_plan(plan_or_partitions)
-        # cache key: the FULL plan + the (bucketed) example-batch
-        # signature — a cached engine keeps its jitted step's compiled
-        # executables, so a replan back onto a measured candidate
-        # (above all: the search winner) costs a lookup + state
-        # reshard instead of a rebuild and a full recompile. The plan
-        # prefix (ISSUE 10 bugfix) keeps two plans with equal device
-        # counts but different mesh shape or run option from
-        # colliding into one engine.
-        key = plan.cache_key() + (
-            bucketing_lib.batch_signature(example_batch),)
-        engine = self._engine_cache.get(key)
-        if engine is None:
-            mesh = mesh_lib.build_mesh(shape=(plan.dp, plan.tp))
-            engine = engine_lib.Engine(self._model, mesh,
-                                       self._engine_config(plan),
-                                       example_batch,
-                                       metrics=self.metrics)
-            self._engine_cache.put(key, engine)
+        engine = self._engine_for_plan(plan, example_batch)
         self._engine = engine
         self._plan = plan
         if isinstance(self._search, MeshSearch) \
@@ -494,9 +497,17 @@ class ParallaxSession:
             # price the whole plan space off THIS engine's lowered
             # artifacts (host-side re-trace at worst, no compile, no
             # device step), then switch to the shortlist's first
-            # candidate; the base engine stays cached for reuse
+            # candidate; the base engine stays cached for reuse. A
+            # persisted calibration file (tune/calibrate.py) replaces
+            # the nominal exchange rates with measured ones; the OOM
+            # preflight screens the shortlist against the HBM budget
+            # BEFORE any candidate pays a measured trial.
+            cal = calibrate_lib.ratios(calibrate_lib.load(
+                self._config.calibration_path))
+            self._search.set_preflight(
+                lambda p: self._preflight_peak(p, example_batch))
             first = self._search.begin(tune_costmodel.inputs_from_engine(
-                engine, self._config.tune_config))
+                engine, self._config.tune_config, calibration=cal))
             if first.cache_key() != plan.cache_key():
                 parallax_log.info(
                     "mesh search: first trial %s (base plan %s kept "
@@ -510,6 +521,41 @@ class ParallaxSession:
             # the reference instead kills and relaunches the cluster
             # (partitions.py:74-138).
             self._state = self._reshard_state(self._state)
+
+    def _engine_for_plan(self, plan: Plan, example_batch):
+        """Get-or-build the engine for one plan (the cache key is the
+        FULL plan + the bucketed example-batch signature — a cached
+        engine keeps its jitted step's compiled executables, so a
+        replan back onto a measured candidate costs a lookup + state
+        reshard instead of a rebuild and a full recompile; the plan
+        prefix is the ISSUE 10 collision fix). Shared by the normal
+        build path and the tuner's OOM preflight."""
+        key = plan.cache_key() + (
+            bucketing_lib.batch_signature(example_batch),)
+        engine = self._engine_cache.get(key)
+        if engine is None:
+            mesh = mesh_lib.build_mesh(shape=(plan.dp, plan.tp))
+            engine = engine_lib.Engine(self._model, mesh,
+                                       self._engine_config(plan),
+                                       example_batch,
+                                       metrics=self.metrics)
+            self._engine_cache.put(key, engine)
+        return engine
+
+    def _preflight_peak(self, plan: Plan, example_batch
+                        ) -> Optional[int]:
+        """The tuner's OOM-preflight probe: compiled-step peak bytes
+        for ``plan`` (obs/memwatch.py). Builds the candidate's engine
+        through the cache and pays its step compile — the same
+        compile its measured trial would pay, just earlier (the
+        executable lands in the engine's AOT table, so a passing
+        plan's trial reuses it); a refused plan's engine is dropped
+        with the other losers at search end. None = unknowable
+        (backend without memory_analysis): the plan passes, refusal
+        requires evidence."""
+        engine = self._engine_for_plan(plan, example_batch)
+        m = memwatch_lib.compiled_step_memory(engine)
+        return int(m["peak_bytes"]) if m else None
 
     def _bucketed_example(self, example_batch):
         """The example batch as the engine will see it: bucketed when
@@ -811,6 +857,9 @@ class ParallaxSession:
             dispatch_s=dt, fetch_block_s=blocked_s,
             h2d_pre_s=h2d_pre_s)
         self.anomaly.observe("step_time_ms", step, wall_s * 1e3)
+        # live-HBM sample post-dispatch (no-op on backends without
+        # memory_stats, structural no-op under the obs killswitch)
+        self.memwatch.sample(step)
         self._profile.after_step(step)
         self._last_outputs = outputs
         new_step = step + 1
@@ -860,6 +909,206 @@ class ParallaxSession:
         seconds — see ``tune.MeshSearch.summary``), else None. Also a
         flight-recorder provider and the bench ``tune`` block."""
         return self._tune_result
+
+    # -- plan observatory (obs/xprof + obs/memwatch, ISSUE 13) ------------
+
+    def profile_steps(self, n: int,
+                      outdir: Optional[str] = None) -> Optional[str]:
+        """Arm a windowed ``jax.profiler`` capture of the NEXT ``n``
+        steps; returns the capture directory (or None on a worker the
+        ``ProfileConfig.profile_worker`` gating excludes — one trace
+        per pod, like the config-driven windows). The captured steps
+        run BLOCKING (``ProfileHook.active`` forces it) so the trace
+        covers real device work; once the window closes, the trace is
+        parsed lazily at the first :meth:`profile_summary` call into
+        the per-op / per-collective attribution (obs/xprof.py),
+        exported as the lazy ``profile.*`` gauges and a chrome-lane
+        summary. ``outdir`` defaults under ``profile_dir`` when
+        configured, else a fresh temp directory."""
+        import os as _os
+        import tempfile
+        # gate/validate BEFORE allocating a directory: an excluded
+        # worker (or a second call mid-capture) must not leak one
+        # abandoned temp dir per call
+        if not self._profile.worker_enabled:
+            return None
+        if self._profile.capture_busy:
+            raise RuntimeError(
+                "a profile capture is already armed/in flight; wait "
+                "for it to finish before requesting another window")
+        if int(n) < 1:
+            raise ValueError(
+                f"profile window must cover >= 1 step, got {n}")
+        if outdir is None:
+            base = self._config.profile_config.profile_dir
+            if base:
+                outdir = _os.path.join(
+                    base, f"window_step{self._host_step}")
+            else:
+                outdir = tempfile.mkdtemp(prefix="parallax-xprof-")
+        ok = self._profile.request_window(self._host_step, n, outdir)
+        return outdir if ok else None
+
+    def _on_profile_stop(self, trace_dir: str, steps: int) -> None:
+        """ProfileHook callback (dispatch thread): record the pending
+        capture; the multi-MB JSON parse happens at the first
+        profile_summary() read, never on the step path."""
+        self._profile_pending = (trace_dir, int(steps))
+        parallax_log.info(
+            "profile window complete: %d step(s) captured in %s "
+            "(profile_summary() parses it)", steps, trace_dir)
+
+    def profile_summary(self) -> Optional[Dict[str, Any]]:
+        """The latest capture window's measured attribution (the
+        obs/xprof ``Attribution.as_dict()``: category shares,
+        per-collective totals, top ops with layer / dense-sparse
+        mapping, and the explicit residual + coverage), parsing any
+        pending trace first. None before any window completed; a
+        failed parse returns ``{"error": ...}`` rather than
+        masquerading as data."""
+        pending, self._profile_pending = self._profile_pending, None
+        if pending is None:
+            return self._profile_attrib
+        path, steps = pending
+        try:
+            trace_doc, tpath = xprof.load_trace(path)
+            idx = (xprof.engine_hlo_index(self._engine)
+                   if self._engine is not None else None)
+            attrib = xprof.attribute(trace_doc, steps=steps,
+                                     hlo_index=idx, source=tpath)
+            self._profile_attrib = attrib.as_dict()
+            self._emit_profile_lanes(attrib)
+            parallax_log.info(
+                "profile attribution: %.1f%% of %.2fms device wall "
+                "attributed (residual %.2fms) over %d op event(s)",
+                100.0 * (attrib.coverage or 0.0), attrib.wall_ms,
+                attrib.residual_ms, attrib.events)
+        except Exception as e:
+            parallax_log.warning("profile attribution failed: %s", e)
+            self._profile_attrib = {
+                "error": f"{type(e).__name__}: {e}", "source": path}
+        return self._profile_attrib
+
+    def _emit_profile_lanes(self, attrib) -> None:
+        """Chrome-lane summary of the parsed window: one span per
+        category (duration = its self-time) plus the residual lane,
+        so the obs chrome export shows the measured split next to
+        the host-side spans."""
+        t0 = time.perf_counter()
+        for cat, row in attrib.by_category.items():
+            trace.record_span("profile." + cat, t0,
+                              t0 + row["self_ms"] / 1e3,
+                              share=row["share"],
+                              events=row["events"])
+        trace.record_span("profile.residual", t0,
+                          t0 + attrib.residual_ms / 1e3,
+                          coverage=attrib.coverage)
+
+    def _register_profile_gauges(self) -> None:
+        """Lazy ``profile.*`` gauges over the latest PARSED
+        attribution — sampled at snapshot time, zero per-step cost,
+        and they never trigger a parse themselves (a metrics scrape
+        must stay cheap)."""
+        def top(key):
+            a = self._profile_attrib
+            return a.get(key) if isinstance(a, dict) else None
+
+        def share(cat):
+            a = self._profile_attrib
+            if not isinstance(a, dict):
+                return None
+            row = (a.get("by_category") or {}).get(cat)
+            return row.get("share") if row else None
+
+        g = self.metrics.gauge
+        g("profile.attribution_coverage").set_fn(
+            lambda: top("coverage"))
+        g("profile.residual_ms").set_fn(lambda: top("residual_ms"))
+        g("profile.step_wall_ms").set_fn(
+            lambda: top("step_wall_ms"))
+        g("profile.steps").set_fn(lambda: top("steps"))
+        for cat in xprof.CATEGORIES:
+            g(f"profile.share.{cat}").set_fn(
+                lambda c=cat: share(c))
+
+    def _profile_for_dump(self) -> Optional[Dict[str, Any]]:
+        """Flight-recorder section: the parsed attribution when one
+        exists; a pending-capture pointer otherwise (an incident dump
+        must not pay a trace parse mid-incident)."""
+        if self._profile_attrib is not None:
+            return self._profile_attrib
+        if self._profile_pending is not None:
+            return {"pending_trace": self._profile_pending[0],
+                    "steps": self._profile_pending[1],
+                    "note": "unparsed; profile_summary() parses it"}
+        return None
+
+    def write_calibration(self, path: Optional[str] = None) -> str:
+        """Close the cost-model loop: compare the settled mesh
+        search's per-term predictions for the WINNER plan against the
+        measured per-op aggregates of the latest profile window, and
+        persist the per-term ``predicted_over_measured`` ratios
+        (tune/calibrate.py) to ``path`` (default
+        ``Config.calibration_path``). The next search on this rig
+        loads them in place of nominal constants. Requires both a
+        settled tune decision and a parsed profile window — refuses
+        loudly otherwise."""
+        path = path or self._config.calibration_path
+        if not path:
+            raise ValueError(
+                "write_calibration needs a path: pass one or set "
+                "Config.calibration_path")
+        attrib = self.profile_summary()
+        if not attrib or attrib.get("error") \
+                or not attrib.get("by_category"):
+            raise ValueError(
+                "write_calibration needs a parsed profile window: "
+                "arm session.profile_steps(n), run those steps, then "
+                "retry (last attribution: %r)"
+                % (attrib.get("error") if attrib else None))
+        tune = self._tune_result
+        if not tune or not tune.get("winner"):
+            raise ValueError(
+                "write_calibration needs a settled mesh search "
+                "(Config.tune_config): the calibration compares the "
+                "winner's predicted terms against the measured ones")
+        entry = next((e for e in tune.get("scored", [])
+                      if e.get("plan") == tune["winner"]["plan"]),
+                     None)
+        if entry is None or not entry.get("terms_ms"):
+            raise ValueError(
+                "tune decision record carries no per-term breakdown "
+                "for the winner; cannot calibrate")
+        terms_s = {k: float(v) / 1e3
+                   for k, v in entry["terms_ms"].items()}
+        predicted = calibrate_lib.predicted_terms_from_cost(terms_s)
+        # the scored terms are CALIBRATED when this search loaded a
+        # calibration file — un-apply the stored ratios so the new
+        # record compares the NOMINAL prediction against the measured
+        # world (otherwise recalibrating off a calibrated run yields
+        # ratios ~1 and the next generation swings back to nominal,
+        # oscillating forever). Exact under sync=True; under
+        # sync=False the hidden-wire overlap makes it approximate.
+        applied = entry.get("calibration") or {}
+        for term in calibrate_lib.TERMS:
+            r = applied.get(term)
+            if r:
+                predicted[term] *= float(r)
+        measured = calibrate_lib.measured_terms_from_attribution(
+            attrib, jax.device_count())
+        if measured is None:
+            raise ValueError(
+                "profile window carried no usable device ops; "
+                "cannot calibrate")
+        record = calibrate_lib.build_record(
+            predicted, measured, basis=tune.get("cost_basis",
+                                                "nominal"),
+            meta={"plan": tune["winner"]["plan"],
+                  "platform": jax.devices()[0].platform,
+                  "num_devices": jax.device_count(),
+                  "steps_profiled": attrib.get("steps"),
+                  "coverage": attrib.get("coverage")})
+        return calibrate_lib.save(path, record)
 
     def sparse_overflow_steps(self) -> int:
         """Total row_sparse_adagrad overflow events so far: steps that
@@ -1237,8 +1486,10 @@ class ParallaxSession:
             with trace.span("session.warmup"):
                 stats = self._engine.warmup(self._state, batch_sizes)
             # the AOT executable makes cost-analysis FLOPs free: attach
-            # them (and the chip peak) so per-step MFU starts flowing
+            # them (and the chip peak) so per-step MFU starts flowing;
+            # same for the compiled-memory account (obs/memwatch.py)
             self._ensure_flops(cheap_only=True)
+            self.memwatch.capture_compiled(self._engine)
             return stats
 
         def _bg():
@@ -1246,6 +1497,7 @@ class ParallaxSession:
                 with trace.span("session.warmup", background=True):
                     self._engine.warmup(self._state, batch_sizes)
                 self._ensure_flops(cheap_only=True)
+                self.memwatch.capture_compiled(self._engine)
             except Exception as e:  # warmup is an optimization: a
                 # failure must never kill the training process
                 parallax_log.warning("background warmup failed: %s", e)

@@ -26,6 +26,7 @@ Enable with ``Config(tune_config=TuneConfig(...))``; the legacy
 """
 
 from parallax_tpu.common.config import TuneConfig
+from parallax_tpu.tune import calibrate
 from parallax_tpu.tune.costmodel import (CostInputs, Plan, PlanCost,
                                          inputs_from_engine, predict,
                                          wire_summary)
@@ -35,5 +36,5 @@ from parallax_tpu.tune.search import MeshSearch, emittable_plans, \
 __all__ = [
     "TuneConfig", "Plan", "PlanCost", "CostInputs", "predict",
     "inputs_from_engine", "wire_summary", "MeshSearch",
-    "enumerate_plans", "emittable_plans",
+    "enumerate_plans", "emittable_plans", "calibrate",
 ]

@@ -148,6 +148,11 @@ class CostInputs:
     hbm_bps: Optional[float] = None
     ici_bps: Optional[float] = None
     peak_is_nominal: bool = True  # False iff a real chip peak resolved
+    # per-term predicted/measured ratios from a persisted calibration
+    # file (tune/calibrate.py): {"on_chip": r, "wire": r}. Each
+    # predicted term is divided by its ratio, replacing the nominal
+    # exchange rates with measured ones — rig-relative by design.
+    calibration: Optional[Dict[str, float]] = None
 
     def resolved(self) -> "CostInputs":
         out = dataclasses.replace(self)
@@ -169,6 +174,10 @@ class PlanCost:
     plan: Plan
     total_s: float
     terms: Dict[str, float]
+    # the per-term ratios that were APPLIED (tune/calibrate.py), or
+    # None for a nominal-constants prediction — every downstream
+    # artifact can tell a calibrated score from a nominal one
+    calibration: Optional[Dict[str, float]] = None
 
     def as_dict(self) -> Dict[str, Any]:
         return {
@@ -178,6 +187,7 @@ class PlanCost:
             "predicted_ms": round(self.total_s * 1e3, 6),
             "terms_ms": {k: round(v * 1e3, 6)
                          for k, v in self.terms.items()},
+            "calibration": self.calibration,
         }
 
 
@@ -288,7 +298,18 @@ def predict(plan: Plan, inputs: CostInputs) -> PlanCost:
         wire_table = fwd + repl
 
     wire_bytes = wire_dense + wire_zero + wire_table
-    wire_s = wire_bytes / (n * inp.ici_bps)
+    # measured calibration (tune/calibrate.py): each term divides by
+    # its persisted predicted/measured ratio, replacing the nominal
+    # exchange rates with the rig's measured ones. Applied to the
+    # underlying terms (compute AND hbm share the on_chip ratio — the
+    # trace can't split what the chip overlaps) so the breakdown stays
+    # consistent with the total.
+    cal = inp.calibration or {}
+    r_on = float(cal.get("on_chip", 1.0)) or 1.0
+    r_wire = float(cal.get("wire", 1.0)) or 1.0
+    compute_s /= r_on
+    hbm_s /= r_on
+    wire_s = wire_bytes / (n * inp.ici_bps) / r_wire
     # sync=False bounded staleness: the delayed-gradient exchange
     # overlaps the next step's compute; only the excess serializes
     hidden_s = min(wire_s, compute_s) if not plan.sync else 0.0
@@ -296,14 +317,16 @@ def predict(plan: Plan, inputs: CostInputs) -> PlanCost:
     return PlanCost(plan=plan, total_s=total, terms={
         "compute_s": compute_s,
         "hbm_s": hbm_s,
-        "wire_dense_s": wire_dense / (n * inp.ici_bps),
-        "wire_zero_shard_s": wire_zero / (n * inp.ici_bps),
-        "wire_table_s": wire_table / (n * inp.ici_bps),
+        "wire_dense_s": wire_dense / (n * inp.ici_bps) / r_wire,
+        "wire_zero_shard_s": wire_zero / (n * inp.ici_bps) / r_wire,
+        "wire_table_s": wire_table / (n * inp.ici_bps) / r_wire,
         "wire_hidden_s": hidden_s,
-    })
+    }, calibration=(dict(cal) if cal else None))
 
 
-def inputs_from_engine(engine, tune_config=None) -> CostInputs:
+def inputs_from_engine(engine, tune_config=None,
+                       calibration: Optional[Dict[str, float]] = None
+                       ) -> CostInputs:
     """Extract :class:`CostInputs` from one built (not necessarily
     compiled) engine — host-side only: a re-trace + lower at worst,
     never a device execution. Lives here (duck-typed) so the model
@@ -358,4 +381,5 @@ def inputs_from_engine(engine, tune_config=None) -> CostInputs:
         hbm_bps=(tc.hbm_gbps * 1e9 if tc and tc.hbm_gbps else None),
         ici_bps=(tc.ici_gbps * 1e9 if tc and tc.ici_gbps else None),
         peak_is_nominal=not bool(
-            (tc and tc.peak_flops) or peak))
+            (tc and tc.peak_flops) or peak),
+        calibration=calibration)

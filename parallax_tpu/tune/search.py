@@ -151,6 +151,15 @@ class MeshSearch:
         self._pruned_equivalent = 0
         self._pruned_by_cost = 0
         self._enumerated = 0
+        # -- OOM preflight (obs/memwatch.py, ISSUE 13) -----------------
+        # fn(plan) -> compiled peak bytes (or None = unknowable); set
+        # by the session before begin(). Plans whose compiled peak
+        # exceeds budget * headroom are REFUSED before any measured
+        # trial — recorded like pruned_equivalent, never silent.
+        self._preflight = None
+        self._hbm_budget: Optional[int] = None
+        self._oom_refusals: List[Dict] = []
+        self._preflight_checked = 0
         self._measured: Dict[Tuple, float] = {}
         self._order: List[Plan] = []
         self._idx = 0
@@ -167,6 +176,12 @@ class MeshSearch:
     @property
     def done(self) -> bool:
         return self._best is not None
+
+    def set_preflight(self, fn) -> None:
+        """Install the compiled-peak probe (``fn(plan) -> bytes or
+        None``) the shortlist is screened through; call before
+        :meth:`begin`."""
+        self._preflight = fn
 
     def begin(self, inputs: CostInputs) -> Plan:
         """Score the space from one probe's lowered artifacts; returns
@@ -197,17 +212,74 @@ class MeshSearch:
             (costmodel.predict(p, inputs) for p in plans),
             key=lambda pc: pc.total_s)
         k = min(int(cfg.top_k), len(self._scored))
-        self._shortlist = [pc.plan for pc in self._scored[:k]]
-        self._pruned_by_cost = len(self._scored) - k
+        self._shortlist = self._preflight_shortlist(k)
+        self._pruned_by_cost = (len(self._scored)
+                                - len(self._shortlist)
+                                - len(self._oom_refusals))
         self._order = list(self._shortlist)
         self._idx = 0
         parallax_log.info(
             "mesh search: %d plan(s) enumerated, %d equivalent + %d "
-            "cost-pruned; trialing top-%d: %s",
+            "cost-pruned + %d OOM-refused; trialing top-%d: %s",
             self._enumerated, self._pruned_equivalent,
-            self._pruned_by_cost, k,
+            self._pruned_by_cost, len(self._oom_refusals),
+            len(self._shortlist),
             [p.describe() for p in self._shortlist])
         return self._order[0]
+
+    def _preflight_shortlist(self, k: int) -> List[Plan]:
+        """The first ``k`` plans of the scored order whose compiled
+        peak fits in the HBM budget (obs/memwatch.py). Walks PAST
+        refused plans so the shortlist is backfilled from the scored
+        tail — a refused front-runner costs a worse candidate a
+        trial, never the whole search. No preflight installed, or no
+        budget resolvable (CPU rig with no TuneConfig.hbm_budget_gb
+        override): the plain top-k, with the skip recorded in
+        summary(). An unknowable peak (backend without
+        memory_analysis) passes — refusal requires EVIDENCE."""
+        from parallax_tpu.obs import memwatch
+        self._hbm_budget = memwatch.hbm_budget_bytes(self.cfg)
+        if self._preflight is None or not self._hbm_budget:
+            return [pc.plan for pc in self._scored[:k]]
+        limit = int(self._hbm_budget * float(self.cfg.hbm_headroom))
+        kept: List[Plan] = []
+        for pc in self._scored:
+            if len(kept) >= k:
+                break
+            self._preflight_checked += 1
+            try:
+                peak = self._preflight(pc.plan)
+            except Exception as e:
+                parallax_log.warning(
+                    "OOM preflight failed for %s (%s); plan passes "
+                    "unchecked", pc.plan.describe(), e)
+                peak = None
+            if peak is not None and int(peak) > limit:
+                refusal = {
+                    "plan": pc.plan.describe(),
+                    "compiled_peak_bytes": int(peak),
+                    "hbm_budget_bytes": int(self._hbm_budget),
+                    "headroom_limit_bytes": limit,
+                    "over_by_bytes": int(peak) - limit,
+                }
+                self._oom_refusals.append(refusal)
+                parallax_log.warning(
+                    "mesh search: plan %s REFUSED before trial — "
+                    "compiled peak %.2f GB exceeds %.2f GB "
+                    "(budget %.2f GB x headroom %.2f)",
+                    pc.plan.describe(), peak / 1e9, limit / 1e9,
+                    self._hbm_budget / 1e9,
+                    float(self.cfg.hbm_headroom))
+                continue
+            kept.append(pc.plan)
+        if not kept:
+            raise RuntimeError(
+                f"every candidate plan's compiled peak exceeds the "
+                f"HBM budget ({self._hbm_budget / 1e9:.2f} GB x "
+                f"headroom {float(self.cfg.hbm_headroom)}): "
+                f"{self._oom_refusals[:4]} — shrink the model/batch "
+                f"or raise TuneConfig.hbm_budget_gb/hbm_headroom")
+        return kept
 
     def first_candidate(self) -> Plan:
         if not self.started:
@@ -278,11 +350,24 @@ class MeshSearch:
                     round(pc.total_s / m, 6) if pc and m else None),
             }
         inp = self._inputs
+        basis = ("nominal-constants (CPU-relative ranking)"
+                 if inp is None or inp.peak_is_nominal
+                 else "device-peak")
+        if inp is not None and inp.calibration:
+            basis = f"calibrated({basis})"
         return {
             "num_devices": self.num_devices,
             "candidates_enumerated": self._enumerated,
             "pruned_equivalent": self._pruned_equivalent,
             "pruned_by_cost_model": self._pruned_by_cost,
+            # OOM preflight (ISSUE 13): refusals are part of the
+            # decision record, exactly like pruned_equivalent — a
+            # plan that never got its trial must say why
+            "pruned_oom": len(self._oom_refusals),
+            "oom_refusals": self._oom_refusals or None,
+            "hbm_budget_bytes": self._hbm_budget,
+            "hbm_headroom": float(self.cfg.hbm_headroom),
+            "preflight_checked": self._preflight_checked,
             "top_k": int(self.cfg.top_k),
             "trials": trials,
             "trials_measured": len(self._measured),
@@ -291,8 +376,9 @@ class MeshSearch:
                 round(self._t_done - self._t0, 3)
                 if self._t0 is not None and self._t_done is not None
                 else None),
-            "cost_basis": ("nominal-constants (CPU-relative ranking)"
-                           if inp is None or inp.peak_is_nominal
-                           else "device-peak"),
+            "cost_basis": basis,
+            "calibration": (dict(inp.calibration)
+                            if inp is not None and inp.calibration
+                            else None),
             "scored": [pc.as_dict() for pc in self._scored],
         }

@@ -41,8 +41,9 @@ import optax  # noqa: E402
 import parallax_tpu as parallax  # noqa: E402
 from parallax_tpu.core import engine as engine_lib, \
     mesh as mesh_lib  # noqa: E402
+from parallax_tpu.obs import xprof  # noqa: E402
 from parallax_tpu.ops import embedding as emb_ops  # noqa: E402
-from parallax_tpu.tune import costmodel  # noqa: E402
+from parallax_tpu.tune import calibrate, costmodel  # noqa: E402
 from parallax_tpu.tune.search import emittable_plans  # noqa: E402
 
 V, D = 32768, 32
@@ -152,6 +153,45 @@ def main() -> int:
         rows.append({"plan": plan.describe(),
                      "measured_ms": round(t * 1e3, 3),
                      "predicted_ms": round(pred * 1e3, 6)})
+    # -- calibration loop (ISSUE 13): profile the probe plan, derive
+    # per-term predicted/measured ratios, round-trip them through the
+    # persisted file, re-score every plan calibrated, and report the
+    # calibrated Spearman NEXT TO the nominal one — the acceptance
+    # claim is calibrated >= uncalibrated on the same measured sweep
+    import tempfile
+    cal_ratios = None
+    spearman_cal = None
+    try:
+        probe_plan, probe_eng, probe_state, _ts = probe_ent
+        prof_steps = 4
+        outdir = tempfile.mkdtemp(prefix="mesh-search-xprof-")
+        for _ in range(2):  # settle out of the timing rounds
+            probe_state, _ = probe_eng.step(probe_state, batch)
+        jax.block_until_ready(probe_state.params)
+        with jax.profiler.trace(outdir):
+            for _ in range(prof_steps):
+                probe_state, _ = probe_eng.step(probe_state, batch)
+            jax.block_until_ready(probe_state.params)
+        trace_doc, _p = xprof.load_trace(outdir)
+        attrib = xprof.attribute(trace_doc, steps=prof_steps).as_dict()
+        meas_terms = calibrate.measured_terms_from_attribution(
+            attrib, num_devices=8)
+        pred_terms = calibrate.predicted_terms_from_cost(
+            costmodel.predict(probe_plan, probe).terms)
+        rec = calibrate.build_record(pred_terms, meas_terms,
+                                     basis="cpu-nominal",
+                                     meta={"driver": "mesh_search"})
+        cal_path = os.path.join(outdir, "calibration.json")
+        calibrate.save(cal_path, rec)
+        cal_ratios = calibrate.ratios(calibrate.load(cal_path))
+    except Exception as e:  # calibration failing must not lose the
+        # nominal result — the test then fails on the missing key,
+        # with the reason in the artifact
+        cal_ratios = None
+        cal_error = f"{type(e).__name__}: {e}"
+    else:
+        cal_error = None
+
     best_t = min(measured)
     worst_i = int(np.argmax(measured))
     model_worst_i = int(np.argmax(predicted))
@@ -159,6 +199,14 @@ def main() -> int:
         (t for ent, t in zip(exhaustive.values(), measured)
          if winner_plan is not None
          and ent[0].cache_key() == winner_plan.cache_key()), None)
+    if cal_ratios:
+        import dataclasses as _dc
+        probe_cal = _dc.replace(probe, calibration=cal_ratios)
+        predicted_cal = [
+            costmodel.predict(ent[0], probe_cal).total_s
+            for ent in exhaustive.values()]
+        spearman_cal = round(_spearman(np.asarray(predicted_cal),
+                                       np.asarray(measured)), 4)
     result = {
         "converged": converged,
         "summary": {k: v for k, v in summary.items() if k != "scored"},
@@ -172,6 +220,9 @@ def main() -> int:
         "exhaustive": rows,
         "spearman": round(_spearman(np.asarray(predicted),
                                     np.asarray(measured)), 4),
+        "spearman_calibrated": spearman_cal,
+        "calibration": cal_ratios,
+        "calibration_error": cal_error,
         "model_worst_is_measured_worst":
             rows[model_worst_i]["plan"] == rows[worst_i]["plan"],
     }

@@ -471,6 +471,14 @@ def test_mesh_search_end_to_end_vs_exhaustive():
     assert result["n_plans"] >= 3
     assert result["spearman"] >= 0.4, result
     assert result["model_worst_is_measured_worst"], result
+    # calibration loop (ISSUE 13): ratios derived from a profiled
+    # window of the probe plan, persisted + reloaded, must leave the
+    # ranking no worse than the nominal constants' on the SAME
+    # measured sweep
+    assert result["calibration_error"] is None, result
+    assert result["calibration"], result
+    assert result["spearman_calibrated"] is not None, result
+    assert result["spearman_calibrated"] >= result["spearman"], result
 
 
 def test_flight_dump_carries_tune_record(tmp_path, rng):

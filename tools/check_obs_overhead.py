@@ -1,9 +1,11 @@
 """Micro-bench: the observability layer must cost <=2% of step wall-time.
 
-ISSUE 2 acceptance (extended by ISSUE 5): the always-on
+ISSUE 2 acceptance (extended by ISSUEs 5 and 13): the always-on
 instrumentation — spans + metrics registry, the per-step timeline
-attribution row, the step-time anomaly detector — on the simple-model
-step loop stays within 2% of the uninstrumented loop. The flight
+attribution row, the step-time anomaly detector, the plan
+observatory's per-step memwatch sample and idle profile-hook bracket
+— on the simple-model step loop stays within 2% of the
+uninstrumented loop. The flight
 recorder does NO per-step work (it dumps bounded rings other
 components already fill), so it has no term here; what is asserted for
 it (and the rest) is the kill switch: with ``obs.disable()`` the
@@ -142,23 +144,49 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
         am_bench = obs.AnomalyMonitor(obs.MetricsRegistry())
         anom_us = _unit_cost_us(
             lambda: am_bench.observe("bench", 0, 1.0))
+        # plan observatory (ISSUE 13): one memwatch sample per step —
+        # unit-costed against the REAL backend stats_fn, so the CPU
+        # rig prices the stats-less latch (a few polls then an
+        # attribute check) and a TPU rig prices the real device poll —
+        # plus the idle profile-hook bracket (profile window NOT
+        # armed: the steady state every non-profiled step pays)
+        mw_bench = obs.MemWatch(obs.MetricsRegistry())
+        mw_us = _unit_cost_us(lambda: mw_bench.sample(0))
+        from parallax_tpu.profiler import ProfileHook
+        ph_bench = ProfileHook(None, 0)
+        ph_us = _unit_cost_us(lambda: (ph_bench.before_step(0),
+                                       ph_bench.after_step(0)))
 
         obs_us = (spans_per_step * span_us + hist_per_step * hist_us
                   + incs_per_step * inc_us + sig_us
-                  + tl_rows_per_step * tl_us + anom_per_step * anom_us)
+                  + tl_rows_per_step * tl_us + anom_per_step * anom_us
+                  + mw_us + ph_us)
         overhead_frac = obs_us / step_us
 
         # kill switch: disabled, the forensics layer must not collect
         # (the flight recorder has no per-step path at all; its dump
-        # triggers are incident-only)
+        # triggers are incident-only). The memwatch check runs
+        # against an ALWAYS-REPORTING fake stats source: the claim is
+        # structural — disabled means no stats poll and no ring
+        # growth even when there would be data to collect.
+        fake_stats = {"tpu:0": {"bytes_in_use": 10,
+                                "peak_bytes_in_use": 12,
+                                "bytes_limit": 100}}
+        mw_ring = obs.MemWatch(obs.MetricsRegistry(),
+                               stats_fn=lambda: dict(fake_stats))
+        mw_ring.sample(0)
         obs.disable()
         try:
             n_tl = tl_bench.total_rows
             n_am = am_bench.total_observed
+            n_mw = mw_ring.total_samples
             tl_bench.record_step(1, 0.0, 1e-3)
             am_bench.observe("bench", 1, 1.0)
+            mw_ring.sample(1)
             killswitch_clean = (tl_bench.total_rows == n_tl
                                 and am_bench.total_observed == n_am)
+            memwatch_killswitch_clean = (mw_ring.total_samples
+                                         == n_mw == 1)
         finally:
             obs.enable()
 
@@ -197,8 +225,11 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
                               "counter_inc": round(inc_us, 3),
                               "batch_signature": round(sig_us, 3),
                               "timeline_row": round(tl_us, 3),
-                              "anomaly_observe": round(anom_us, 3)},
+                              "anomaly_observe": round(anom_us, 3),
+                              "memwatch_sample": round(mw_us, 3),
+                              "profile_hook_idle": round(ph_us, 3)},
             "killswitch_clean": killswitch_clean,
+            "memwatch_killswitch_clean": memwatch_killswitch_clean,
             "ab_overhead_frac": round(ab, 4),
         }
     finally:
@@ -320,7 +351,8 @@ def main(argv=None) -> int:
     result = measure(steps=args.steps, batch=args.batch)
     result["max_overhead"] = args.max_overhead
     result["ok"] = (result["overhead_frac"] <= args.max_overhead
-                    and result["killswitch_clean"])
+                    and result["killswitch_clean"]
+                    and result["memwatch_killswitch_clean"])
     if not args.skip_serve:
         result["serve"] = measure_serve()
         result["ok"] = (result["ok"]

@@ -226,6 +226,17 @@ SECONDARY_GATES = (
     ("tune.search_seconds", False),
     ("tune.predicted_over_measured", False),
     ("tune.predicted_over_measured", True),
+    # plan observatory (ISSUE 13, bench "profile" block): attribution
+    # coverage dropping means the parser stopped explaining the
+    # measured device step wall (a taxonomy/track regression, or a
+    # runtime that moved its op events); the wire-term calibration
+    # ratio is gated in BOTH directions — same two-row two-sided
+    # pattern as tune.predicted_over_measured: the absolute value is
+    # CPU-relative on the CPU rig, DRIFT means the cost model and the
+    # measured world are coming apart
+    ("profile.attribution_coverage", True),
+    ("profile.calibration.wire_predicted_over_measured", False),
+    ("profile.calibration.wire_predicted_over_measured", True),
 )
 
 

@@ -1,6 +1,7 @@
 """Per-device HBM accounting for the flagship configuration.
 
-Two layers of evidence (committed under perf/ per ROADMAP item 12):
+Three layers of evidence (committed under perf/ per ROADMAP item 12;
+the third added by ISSUE 13):
 
 1. **State bytes, exact, from the sharding plan** (abstract eval — no
    allocation): params / optimizer state / slice-adagrad accumulators,
@@ -8,11 +9,19 @@ Two layers of evidence (committed under perf/ per ROADMAP item 12):
    design pays off — the 793k-vocab tables and their accumulators are
    row-sharded while the LSTM stack is replicated.
 2. **Compiled-step memory analysis** (XLA `memory_analysis()` on the
-   jitted training step): activation/temp footprint the compiler
+   jitted training step, through the shared
+   ``obs/memwatch.compiled_memory`` helper — one owner for the field
+   set and the derived peak): activation/temp footprint the compiler
    actually schedules, argument/output aliasing included. Compiling the
    full flagship on the CPU emulator is expensive, so this layer runs
    on a scaled config by default (`--compile_scale`) and on the real
    one with `--compile_scale 1`.
+3. **Runtime-measured live peak** (``obs/memwatch.MemWatch`` sampling
+   ``device_memory_stats`` across real executed steps): what the
+   allocator actually held, next to what the plan says it should and
+   what the compiler scheduled. Honest on the CPU rig: XLA:CPU
+   reports no memory stats, so this layer records ``unavailable``
+   there instead of a fabricated number — it goes live on TPU capture.
 
 Run: python tools/memory_report.py [--out perf/MEMORY_r04.json]
 """
@@ -137,13 +146,55 @@ def compiled_accounting(n_chips=8, scale=8):
         for k, v in placed.items()}
     with eng.mesh:
         compiled = eng._step_jit.lower(state, abstract_batch).compile()
-    ma = compiled.memory_analysis()
-    fields = ("temp_size_in_bytes", "argument_size_in_bytes",
-              "output_size_in_bytes", "generated_code_size_in_bytes",
-              "alias_size_in_bytes")
-    return {"vocab_scale": scale,
-            **{f: int(getattr(ma, f)) for f in fields
-               if hasattr(ma, f)}}
+    # the shared field set + derived peak (obs/memwatch.py) — the same
+    # numbers the tuner's OOM preflight judges
+    from parallax_tpu.obs import memwatch
+    mem = memwatch.compiled_memory(compiled)
+    if mem is None:
+        raise RuntimeError("memory_analysis unavailable on this "
+                           "backend")
+    return {"vocab_scale": scale, **mem}
+
+
+def runtime_accounting(n_chips=8, scale=8, steps=5):
+    """Third evidence layer: live allocator peak across real executed
+    steps of the scaled config (obs/memwatch ring over
+    device_memory_stats). ``unavailable`` — honestly — on backends
+    without memory stats (XLA:CPU)."""
+    import jax
+    import numpy as np
+
+    from parallax_tpu.common.config import ParallaxConfig
+    from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
+    from parallax_tpu.models import lm1b
+    from parallax_tpu.obs.memwatch import MemWatch
+
+    mesh = mesh_lib.build_mesh(jax.devices()[:n_chips],
+                               num_partitions=n_chips)
+    cfg = lm1b.LM1BConfig(vocab_size=793470 // scale,
+                          num_samples=8192 // scale,
+                          num_partitions=n_chips,
+                          sparse_grad_mode="slices")
+    model = lm1b.build_model(cfg)
+    batch = lm1b.make_batch(np.random.default_rng(0), 128 * n_chips,
+                            20, cfg.vocab_size)
+    config = ParallaxConfig(run_option="HYBRID", search_partitions=False,
+                            sparse_grad_mode="slices")
+    eng = engine_lib.Engine(model, mesh, config, batch)
+    state = eng.init_state(0)
+    mw = MemWatch()
+    for step in range(steps):
+        state, _ = eng.step(state, batch)
+        jax.block_until_ready(state.params)
+        mw.sample(step)
+    peak = mw.live_peak_bytes()
+    return {
+        "vocab_scale": scale, "steps": steps,
+        "live_peak_bytes": peak,
+        "note": (None if peak else
+                 "backend reports no device memory stats "
+                 "(XLA:CPU); goes live on TPU capture"),
+    }
 
 
 def main():
@@ -162,6 +213,11 @@ def main():
             args.n_chips, args.compile_scale)
     except Exception as e:  # memory_analysis availability varies
         result["compiled_step"] = {"error": str(e)[:300]}
+    try:
+        result["measured_runtime"] = runtime_accounting(
+            args.n_chips, args.compile_scale)
+    except Exception as e:
+        result["measured_runtime"] = {"error": str(e)[:300]}
     line = json.dumps(result)
     print(line)
     if args.out:

@@ -1,25 +1,24 @@
 """Profile one LM1B hybrid train step on the live backend.
 
-Captures a jax.profiler trace of a few steady-state steps and then
-aggregates TPU op durations from the trace so the hotspot is readable
-without TensorBoard. Usage:
+Captures a jax.profiler trace of a few steady-state steps and
+summarizes it through the shared ``obs/xprof`` parser (ONE owner for
+trace parsing, ISSUE 13) so the hotspot is readable without
+TensorBoard: top ops by self-duration with their taxonomy category,
+the category split, and the coverage/residual account. Usage:
 
     python tools/profile_lm1b.py [outdir]
-
-Prints the top-20 ops by total self-duration on the device track.
 """
 
 from __future__ import annotations
 
-import glob
-import gzip
-import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+TRACED_STEPS = 8
 
 
 def run_trace(outdir: str) -> None:
@@ -51,7 +50,7 @@ def run_trace(outdir: str) -> None:
         sess.run("loss", feed_dict=batches[i % 4])
     jax.block_until_ready(sess.state.params)
     with jax.profiler.trace(outdir):
-        for i in range(8):
+        for i in range(TRACED_STEPS):
             sess.run("loss", feed_dict=batches[i % 4])
         jax.block_until_ready(sess.state.params)
     t0 = time.perf_counter()
@@ -65,39 +64,34 @@ def run_trace(outdir: str) -> None:
 
 
 def summarize(outdir: str, top: int = 25) -> None:
-    paths = glob.glob(os.path.join(outdir, "**", "*.trace.json.gz"),
-                      recursive=True)
-    if not paths:
-        print("no trace.json.gz found under", outdir)
+    """Shared-parser summary (obs/xprof): top ops by SELF duration
+    (nesting resolved, unlike the old inline aggregation that counted
+    a while loop and its body twice), the category split, and the
+    coverage/residual account."""
+    from parallax_tpu.obs import xprof
+
+    try:
+        trace, path = xprof.load_trace(outdir)
+    except FileNotFoundError:
+        print("no trace.json(.gz) found under", outdir)
         return
-    path = max(paths, key=os.path.getmtime)
-    with gzip.open(path, "rt") as f:
-        trace = json.load(f)
-    events = trace.get("traceEvents", [])
-    # device tracks: pid whose process_name metadata mentions TPU/device;
-    # fall back to aggregating every complete event by name.
-    pid_names = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            pid_names[e["pid"]] = e["args"].get("name", "")
-    device_pids = {p for p, n in pid_names.items()
-                   if "TPU" in n or "/device" in n.lower()}
-    totals, counts = {}, {}
-    for e in events:
-        if e.get("ph") != "X":
-            continue
-        if device_pids and e.get("pid") not in device_pids:
-            continue
-        name = e.get("name", "?")
-        totals[name] = totals.get(name, 0.0) + e.get("dur", 0.0)
-        counts[name] = counts.get(name, 0) + 1
-    ranked = sorted(totals.items(), key=lambda kv: -kv[1])[:top]
-    width = max((len(n) for n, _ in ranked), default=10)
-    print(f"# device tracks: "
-          f"{[pid_names[p] for p in device_pids] or 'ALL (no device pid)'}")
-    for name, us in ranked:
-        print(f"{name[:90]:<{min(width, 90)}}  "
-              f"{us / 1e3:9.2f} ms  x{counts[name]}")
+    attrib = xprof.attribute(trace, steps=TRACED_STEPS, top=top,
+                             source=path)
+    print(f"# {attrib.events} device op event(s) on {attrib.tracks} "
+          f"track(s) [{attrib.track_basis}]")
+    if attrib.coverage is not None:
+        print(f"# device step wall {attrib.wall_ms:.2f} ms, "
+              f"attributed {attrib.attributed_ms:.2f} ms "
+              f"({attrib.coverage * 100:.1f}%), residual "
+              f"{attrib.residual_ms:.2f} ms")
+    for cat, row in attrib.by_category.items():
+        print(f"# {cat:<11} {row['self_ms']:9.2f} ms  "
+              f"share {row['share']:.3f}  x{row['events']}")
+    width = max((len(r["op"]) for r in attrib.top_ops), default=10)
+    for r in attrib.top_ops:
+        print(f"{r['op'][:90]:<{min(width, 90)}}  "
+              f"{r['self_ms']:9.2f} ms  x{r['count']:<5} "
+              f"[{r['category']}]")
 
 
 if __name__ == "__main__":
