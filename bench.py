@@ -64,6 +64,12 @@ PROBE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #               gated, deadline_miss_budget_consumed) and serve.fleet
 #               gains incident_correlated / ttft_decomp_max_rel_err
 #               (ISSUE 12) — new keys, gate-side skip, no bump.
+#               r14+: a top-level "lstm" block (ISSUE 14,
+#               tools/bench_lstm.py: pallas-backward vs recompute-XLA
+#               fwd+bwd A/B at op level and through one LM1B training
+#               step, the interpret-tax witness, and the analytic
+#               fwd+bwd HBM-bytes story at the flagship shape) — a
+#               new block with gate-side skip semantics, no bump.
 BENCH_VERSION = 3
 BASELINE_BASIS = ("sampled-softmax vs full-softmax LM1B at the same "
                   "memory-limited batch; headline measured separately at "
@@ -584,6 +590,25 @@ def worker_main():
             print(f"# decode bench failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
 
+    # LSTM backward block (ISSUE 14): the flagship recurrence's
+    # fwd+bwd A/B — pallas backward kernel vs the recompute-XLA VJP —
+    # at op level and through one real LM1B training step, plus the
+    # analytic fwd+bwd HBM-bytes story at the true flagship shape.
+    # Off-TPU the pallas programs run interpreted, so the measured
+    # ratios carry the interpret-tax witness and the CPU-relative
+    # caveat in-artifact; tools/check_regression.py secondary-gates
+    # lstm.op_ms.pallas_bwd and (drift) lstm.pallas_over_recompute.
+    # PARALLAX_BENCH_LSTM=0 skips.
+    lstm_snap = None
+    if os.environ.get("PARALLAX_BENCH_LSTM", "1") != "0":
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from tools import bench_lstm
+            lstm_snap = bench_lstm.measure()
+        except Exception as e:
+            print(f"# lstm bench failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
     # Auto-tuner block (ISSUE 10): one MeshSearch decision end to end
     # on the smoke-scale flagship — candidates enumerated / pruned /
     # trialed, predicted-vs-measured ms for the measured winner,
@@ -766,6 +791,10 @@ def worker_main():
         # KV-cached vs cache-less decode ratios (the serve-side latency
         # primitive), tracked per round
         "decode": decode_snap,
+        # pallas LSTM backward A/B (ISSUE 14): kernel vs recompute-XLA
+        # fwd+bwd step_ms (CPU-relative off-TPU, interpret-tax witness
+        # stamped) + the analytic flagship HBM-bytes story
+        "lstm": lstm_snap,
         # checkpoint/recovery costs (ISSUE 9): save/restore latency,
         # bytes, async-vs-sync step-overhead A/B, chaos-harness outcome
         "ckpt": ckpt_snap,

@@ -76,9 +76,15 @@ class LM1BConfig:
     # T % unroll need not hold (lax.scan handles remainders).
     lstm_scan_unroll: int = 1
     # 'pallas': run the recurrence as the VMEM-resident kernel
-    # (ops/pallas_lstm.py) — weights fetched once per batch tile instead
-    # of once per time step (~T-fold HBM-traffic cut on the scan's
-    # dominant term), recompute-XLA backward. 'xla' (default): lax.scan.
+    # (ops/pallas_lstm.py) — weights fetched once per batch tile
+    # instead of once per time step (~T-fold HBM-traffic cut on the
+    # scan's dominant term), forward AND backward: the time-reversed
+    # backward kernel consumes saved residuals (gate activations + c
+    # trajectory) with fp32 (dc, dh) carries, so training neither
+    # recomputes the forward nor re-fetches weights per step. Off-TPU
+    # (and on VMEM-unfittable sizes) the backward drops to the XLA
+    # residual-scan executor; PARALLAX_LSTM_BWD overrides
+    # (auto|kernel|scan|recompute). 'xla' (default): lax.scan.
     lstm_impl: str = "xla"
 
     @property

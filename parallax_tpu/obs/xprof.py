@@ -229,6 +229,12 @@ class Attribution:
     dense_sparse: Dict[str, float]
     by_module: Dict[str, float]
     source: Optional[str] = None
+    # forward-vs-backward self-time split (ISSUE 14): joined from the
+    # HLO op_name scope — XLA stamps backward ops with transpose(...)
+    # scopes — so a training profile says how much of the step is the
+    # backward. Needs hlo_index; all-unmapped without it (visible,
+    # never wrong).
+    fwd_bwd: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def step_wall_ms(self) -> Optional[float]:
@@ -289,6 +295,8 @@ def attribute(trace: Dict, steps: Optional[int] = None,
     layer_tot: Dict[str, float] = {}
     split_tot = {"sparse_self_ms": 0.0, "dense_self_ms": 0.0,
                  "unmapped_self_ms": 0.0}
+    dir_tot = {"forward_self_ms": 0.0, "backward_self_ms": 0.0,
+               "unmapped_self_ms": 0.0}
     mod_tot: Dict[str, float] = {}
     for e in ops:
         s_ms = self_us[id(e)] / 1e3
@@ -314,6 +322,11 @@ def attribute(trace: Dict, steps: Optional[int] = None,
                "dense": "dense_self_ms"}.get(split,
                                              "unmapped_self_ms")
         split_tot[key] += s_ms
+        direction = direction_of(meta) if meta else None
+        dkey = {"forward": "forward_self_ms",
+                "backward": "backward_self_ms"}.get(
+                    direction, "unmapped_self_ms")
+        dir_tot[dkey] += s_ms
 
     total_self = sum(cat_tot.values()) or 1.0
     by_category = {
@@ -352,7 +365,8 @@ def attribute(trace: Dict, steps: Optional[int] = None,
                                    key=lambda kv: -kv[1])[:top]},
         dense_sparse={k: round(v, 4) for k, v in split_tot.items()},
         by_module={k: round(v, 4) for k, v in mod_tot.items()},
-        source=source)
+        source=source,
+        fwd_bwd={k: round(v, 4) for k, v in dir_tot.items()})
 
 
 # -- HLO metadata joins ------------------------------------------------------
@@ -406,6 +420,23 @@ def layer_of(meta: Optional[Dict[str, Any]]) -> Optional[str]:
     if src:
         return os.path.basename(src)
     return parts[0] if parts else None
+
+
+def direction_of(meta: Optional[Dict[str, Any]]) -> Optional[str]:
+    """``"backward"`` when the op's ``op_name`` scope path carries a
+    ``transpose(...)`` component (XLA's AD-transpose marker — the
+    whole backward pass lives under it), ``"forward"`` for any other
+    op_name'd op, None when the metadata carries no op_name at all.
+    The join key for the training-step fwd/bwd attribution row
+    (tools/profile_lm1b.py, ISSUE 14)."""
+    if not meta:
+        return None
+    op_name = meta.get("op_name") or ""
+    if not op_name:
+        return None
+    if any(p.startswith("transpose(") for p in op_name.split("/")):
+        return "backward"
+    return "forward"
 
 
 def sparse_split(meta: Optional[Dict[str, Any]],
@@ -478,6 +509,6 @@ def load_trace(path_or_dir: str) -> Tuple[Dict, str]:
 __all__ = [
     "Attribution", "CATEGORIES", "SPARSE_SOURCES", "attribute",
     "build_hlo_index", "categorize", "device_op_events",
-    "engine_hlo_index", "find_trace_file", "layer_of", "load_trace",
-    "merge_intervals", "sparse_split",
+    "direction_of", "engine_hlo_index", "find_trace_file", "layer_of",
+    "load_trace", "merge_intervals", "sparse_split",
 ]

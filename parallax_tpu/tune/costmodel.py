@@ -141,6 +141,19 @@ class CostInputs:
     table_grad_bytes: int = 0     # tables' dense [V, D] gradient bytes
     sparse_fwd_bytes: int = 0     # sparse shard-exchange bytes at probe
     sparse_repl_bytes: int = 0    # cross-replica combine bytes at probe
+    # Pallas-LSTM kernel HBM traffic (ops/pallas_lstm.kernel_hbm_bytes
+    # via its trace records): XLA's cost_analysis prices a pallas
+    # custom call at ~zero bytes accessed, so a kernel-served
+    # recurrence would otherwise score as HBM-free — exactly backwards
+    # from the scan path, whose T x weight re-fetch cost_analysis DOES
+    # price. ``lstm_stream_bytes`` is mesh-global and scales with the
+    # global batch (fixed total traffic however B is sharded);
+    # ``lstm_resident_bytes`` is the once-per-call weight fetch EVERY
+    # device pays (total grows with the device count). Both fold into
+    # the HBM roofline term, so PR 13's on_chip calibration sees the
+    # kernel too.
+    lstm_stream_bytes: float = 0.0
+    lstm_resident_bytes: float = 0.0
     probe_dp: int = 1
     probe_tp: int = 1
     num_devices: int = 1
@@ -258,7 +271,12 @@ def predict(plan: Plan, inputs: CostInputs) -> PlanCost:
     inp = inputs.resolved()
     n = plan.num_devices
     compute_s = float(inp.flops) / (n * inp.peak_flops)
-    hbm_s = float(inp.hbm_bytes) / (n * inp.hbm_bps)
+    # kernel-aware HBM term: stream bytes split across devices like
+    # cost_analysis bytes; resident (weight-fetch) bytes are paid per
+    # device, so the mesh-global total is resident * n
+    lstm_bytes = (float(inp.lstm_stream_bytes)
+                  + float(inp.lstm_resident_bytes) * n)
+    hbm_s = (float(inp.hbm_bytes) + lstm_bytes) / (n * inp.hbm_bps)
 
     # dense (non-table) grads: full-mesh ring in every run option (the
     # batch axis spans the whole mesh, so every device holds a full
@@ -317,6 +335,10 @@ def predict(plan: Plan, inputs: CostInputs) -> PlanCost:
     return PlanCost(plan=plan, total_s=total, terms={
         "compute_s": compute_s,
         "hbm_s": hbm_s,
+        # informational sub-term (INCLUDED in hbm_s, not additive):
+        # the pallas-LSTM kernel's share of the HBM ceiling, so the
+        # tune_decision artifact shows the kernel was priced
+        "hbm_lstm_kernel_s": lstm_bytes / (n * inp.hbm_bps) / r_on,
         "wire_dense_s": wire_dense / (n * inp.ici_bps) / r_wire,
         "wire_zero_shard_s": wire_zero / (n * inp.ici_bps) / r_wire,
         "wire_table_s": wire_table / (n * inp.ici_bps) / r_wire,
@@ -364,6 +386,37 @@ def inputs_from_engine(engine, tune_config=None,
         sparse_repl += int(repl_bytes)
 
     mesh = engine.mesh
+    # pallas-LSTM kernel traffic (ops/pallas_lstm trace records for
+    # THIS engine's mesh — recorded when the step traced; the
+    # cost_analysis lower above is such a trace). A record whose
+    # backward runs as the XLA residual scan or the recompute VJP
+    # counts only the forward custom call ( + residual streams for
+    # 'scan'): the XLA backward itself is priced by cost_analysis.
+    lstm_stream = 0.0
+    lstm_resident = 0.0
+    try:
+        from parallax_tpu.ops import pallas_lstm
+        # records are per distinct trace signature, so one layer
+        # traced at several batch shapes (compile-ahead buckets, an
+        # eval step) leaves one record per B — collapse each
+        # (layer-shape, sharding, bwd) group to its LARGEST batch,
+        # the step the roofline prices, instead of summing buckets
+        # into phantom traffic
+        by_layer: Dict[Tuple, dict] = {}
+        for rec in pallas_lstm.trace_records(mesh):
+            key = (rec["T"], rec["E"], rec["H"], rec["P"],
+                   rec["x_itemsize"], rec["w_itemsize"],
+                   rec["n_shards"], rec["bwd"])
+            if key not in by_layer or rec["B"] > by_layer[key]["B"]:
+                by_layer[key] = rec
+        for rec in by_layer.values():
+            acct = pallas_lstm.kernel_hbm_bytes(
+                rec["T"], rec["B"], rec["E"], rec["H"], rec["P"],
+                rec["x_itemsize"], rec["w_itemsize"], bwd=rec["bwd"])
+            lstm_stream += acct["stream_bytes"]
+            lstm_resident += acct["resident_bytes_per_device"]
+    except Exception:   # never fail plan pricing for the hint term
+        pass
     dev = jax.devices()[0]
     import os
     peak = flops_lib.device_peak_flops(
@@ -374,6 +427,8 @@ def inputs_from_engine(engine, tune_config=None,
         flops=flops, hbm_bytes=hbm,
         dense_grad_bytes=dense_b, table_grad_bytes=table_b,
         sparse_fwd_bytes=sparse_fwd, sparse_repl_bytes=sparse_repl,
+        lstm_stream_bytes=lstm_stream,
+        lstm_resident_bytes=lstm_resident,
         probe_dp=int(mesh.shape[mesh_lib.AXIS_REPL]),
         probe_tp=int(mesh.shape[mesh_lib.AXIS_SHARD]),
         num_devices=mesh_lib.num_devices(mesh),

@@ -1,4 +1,14 @@
-"""Pallas VMEM-resident LSTM scan (ops/pallas_lstm) numerics tests."""
+"""Pallas VMEM-resident LSTM scan (ops/pallas_lstm) numerics tests.
+
+Backward-path tolerance budgets (ISSUE 14, mirrors the bf16 forward
+budget below): at fp32 compute the kernel backward matches the
+XLA-scan VJP to reassociation (rtol 1e-4 — the dW accumulations are
+one batched matmul vs the scan transpose's sequential adds); at bf16
+the two differ by bf16 rounding — the kernel rounds d_gates/dh_total
+to the weight dtype once per step and stores d_xw at the compute
+dtype, while the XLA VJP accumulates dW across steps in *bf16* — and
+the budget is 2e-2 relative-to-peak (measured ~5e-3 at the flagship
+weight shape)."""
 
 import jax
 import jax.numpy as jnp
@@ -170,3 +180,270 @@ class TestFlagshipSize:
                 t((2, 8, self.FE)), t((self.FE + P_, 4 * H_)),
                 t((4 * H_,)), t((H_, P_)), impl="pallas",
                 interpret=False)
+
+
+def _grad_fn(impl, g_out, **kw):
+    return jax.jit(jax.grad(
+        lambda x, w, b, wp: jnp.sum(pallas_lstm.lstm_scan(
+            x, w, b, wp, impl=impl, **kw).astype(jnp.float32) * g_out),
+        argnums=(0, 1, 2, 3)))
+
+
+class TestBackwardKernel:
+    """ISSUE 14: the time-reversed VMEM-resident backward — gradient
+    parity vs the XLA-scan VJP, the refusal/size-guard fallback, and
+    the fp32 cotangent-accumulation contract."""
+
+    def test_all_bwd_paths_match_xla_vjp_fp32(self, args):
+        g_out = jnp.asarray(np.random.default_rng(7).standard_normal(
+            (T, B, P)).astype(np.float32))
+        want = _grad_fn("xla", g_out)(*args)
+        for bwd in ("auto", "kernel", "recompute"):
+            got = _grad_fn("pallas", g_out, bwd_impl=bwd)(*args)
+            for g, e, name in zip(got, want, ("x", "w", "b", "wp")):
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(e), rtol=1e-4,
+                    atol=1e-5, err_msg=f"{bwd}:{name}")
+
+    def test_bwd_kernel_parity_ragged_shape(self, rng):
+        """Ragged/small dims: batch not a multiple of the tile, odd T
+        — the tile auto-shrink and reversed index maps must stay
+        exact (fp32, tight budget)."""
+        T_, B_, E_, H_, P_ = 5, 6, 24, 40, 24
+
+        def t(shape, s=0.3):
+            return jnp.asarray(rng.standard_normal(shape) * s,
+                               jnp.float32)
+        a = (t((T_, B_, E_)), t((E_ + P_, 4 * H_)), t((4 * H_,), 0.0),
+             t((H_, P_)))
+        g_out = t((T_, B_, P_))
+        got = _grad_fn("pallas", g_out, bwd_impl="kernel",
+                       batch_tile=4)(*a)
+        want = _grad_fn("xla", g_out)(*a)
+        for g, e, name in zip(got, want, ("x", "w", "b", "wp")):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(e),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=name)
+
+    def test_bwd_kernel_parity_flagship_weight_shape(self, rng):
+        """The acceptance shape: bf16 [1024, 8192] gate matrix (what
+        gates compilation; batch/time small so CPU interpret stays
+        fast). Budget 2e-2 relative-to-peak per the module docstring
+        (measured ~5e-3); the XLA VJP side accumulates dW in bf16, so
+        the budget covers BOTH paths' roundings."""
+        FE, FH, FP = TestFlagshipSize.FE, TestFlagshipSize.FH, \
+            TestFlagshipSize.FP
+        T_, B_ = 3, 8
+
+        def t(shape, s=0.05):
+            return jnp.asarray(rng.standard_normal(shape) * s,
+                               jnp.bfloat16)
+        a = (t((T_, B_, FE)),
+             t((FE + FP, 4 * FH), 1.0 / np.sqrt(FE + FP)),
+             jnp.zeros((4 * FH,), jnp.bfloat16),
+             t((FH, FP), 1.0 / np.sqrt(FH)))
+        g_out = jnp.asarray(rng.standard_normal(
+            (T_, B_, FP)).astype(np.float32))
+        got = _grad_fn("pallas", g_out, bwd_impl="kernel")(*a)
+        want = _grad_fn("xla", g_out)(*a)
+        for g, e, name in zip(got, want, ("x", "w", "b", "wp")):
+            gf = np.asarray(g, np.float32)
+            ef = np.asarray(e, np.float32)
+            peak = np.abs(ef).max() or 1.0
+            assert np.abs(gf - ef).max() / peak < 2e-2, name
+
+    def test_auto_uses_scan_executor_off_tpu(self, args):
+        """Off-TPU (interpret) 'auto' picks the XLA residual-scan
+        executor — the identical algorithm without the interpreter
+        tax — and its gradients track the kernel executor tightly
+        (same math, different time-loop owner)."""
+        pallas_lstm.reset_trace_records()
+        g_out = jnp.asarray(np.random.default_rng(3).standard_normal(
+            (T, B, P)).astype(np.float32))
+        got = _grad_fn("pallas", g_out, bwd_impl="auto")(*args)
+        (rec,) = pallas_lstm.trace_records(None)
+        assert rec["bwd"] == "scan"
+        want = _grad_fn("pallas", g_out, bwd_impl="kernel")(*args)
+        for g, e, name in zip(got, want, ("x", "w", "b", "wp")):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(e),
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg=name)
+
+    def test_scan_executor_matches_xla_vjp(self, args):
+        g_out = jnp.asarray(np.random.default_rng(5).standard_normal(
+            (T, B, P)).astype(np.float32))
+        got = _grad_fn("pallas", g_out, bwd_impl="scan")(*args)
+        want = _grad_fn("xla", g_out)(*args)
+        for g, e, name in zip(got, want, ("x", "w", "b", "wp")):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(e),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=name)
+
+    def test_auto_resolution_non_interpret(self, monkeypatch):
+        """The real-TensorCore resolution (interpret=False, abstract
+        eval only — nothing executes): 'auto' takes the kernel when
+        the backward streams fit the budget, the residual-scan
+        executor when only the residual-saving forward does."""
+        FE, FH, FP = TestFlagshipSize.FE, TestFlagshipSize.FH, \
+            TestFlagshipSize.FP
+        shapes = (jax.ShapeDtypeStruct((4, 128, FE), jnp.bfloat16),
+                  jax.ShapeDtypeStruct((FE + FP, 4 * FH),
+                                       jnp.bfloat16),
+                  jax.ShapeDtypeStruct((4 * FH,), jnp.bfloat16),
+                  jax.ShapeDtypeStruct((FH, FP), jnp.bfloat16))
+
+        def probe():
+            pallas_lstm.reset_trace_records()
+            jax.eval_shape(lambda *a: pallas_lstm.lstm_scan(
+                *a, impl="pallas", interpret=False), *shapes)
+            (rec,) = pallas_lstm.trace_records(None)
+            return rec["bwd"]
+
+        assert probe() == "kernel"           # default budget: fits
+        # between the residual-saving forward's bt=1 resident set
+        # (10,571,776 B) and the backward kernel's (10,586,112 B):
+        # only the backward fit fails
+        monkeypatch.setenv("PARALLAX_LSTM_VMEM_BUDGET", "10576000")
+        assert probe() == "scan"
+
+    def test_bwd_env_override_forces_recompute(self, args,
+                                               monkeypatch):
+        monkeypatch.setenv("PARALLAX_LSTM_BWD", "recompute")
+        pallas_lstm.reset_trace_records()
+        g_out = jnp.ones((T, B, P), jnp.float32)
+        _grad_fn("pallas", g_out, bwd_impl="kernel")(*args)
+        (rec,) = pallas_lstm.trace_records(None)
+        assert rec["bwd"] == "recompute"
+
+    def test_bwd_kernel_refusal_message(self):
+        """bwd_impl='kernel' + interpret=False at an un-residentable
+        size raises the clear guard error, not a Mosaic internal."""
+        H_, P_ = 8 * TestFlagshipSize.FH, 4 * TestFlagshipSize.FP
+        E_ = TestFlagshipSize.FE
+
+        def t(shape):
+            return jnp.zeros(shape, jnp.bfloat16)
+        with pytest.raises(ValueError, match="VMEM budget"):
+            pallas_lstm.lstm_scan(
+                t((2, 8, E_)), t((E_ + P_, 4 * H_)), t((4 * H_,)),
+                t((H_, P_)), impl="pallas", bwd_impl="kernel",
+                interpret=False)
+
+    def test_fp32_cotangent_accumulation_pin(self, rng):
+        """Satellite 1 pin: the r13 backward downcast the cotangent to
+        the input dtype and let the XLA scan transpose accumulate dW
+        in bf16; the fixed fallback widens to fp32 and casts ONCE at
+        the end. Against the fp32-accumulated reference (the widened
+        VJP's pre-cast values), the old path's dw/dwp error must be
+        measurably larger than the new path's — the difference this
+        test pins is exactly what the fix bought."""
+        T_, B_, E_, H_, P_ = 12, 8, 64, 128, 64
+
+        def t(shape, s=0.2):
+            return jnp.asarray(rng.standard_normal(shape) * s,
+                               jnp.bfloat16)
+        x = t((T_, B_, E_))
+        w = t((E_ + P_, 4 * H_), 1.0 / np.sqrt(E_ + P_))
+        b = jnp.zeros((4 * H_,), jnp.bfloat16)
+        wp = t((H_, P_), 1.0 / np.sqrt(H_))
+        g = jnp.asarray(rng.standard_normal(
+            (T_, B_, P_)).astype(np.float32))
+        f32 = jnp.float32
+
+        def wide(x32, w32, b32, wp32):
+            return pallas_lstm.lstm_scan_reference(
+                x32, w32, b32, wp32, out_dtype=f32,
+                matmul_dtype=w.dtype, store_dtype=x.dtype)
+        _, vjp = jax.vjp(wide, x.astype(f32), w.astype(f32),
+                         b.astype(f32), wp.astype(f32))
+        truth = vjp(g)                       # fp32-accumulated, uncast
+        _, vjp_old = jax.vjp(pallas_lstm.lstm_scan_reference,
+                             x, w, b, wp)
+        old = vjp_old(g.astype(x.dtype))     # the r13 behavior
+        new = pallas_lstm._bwd_recompute(x, w, b, wp, g)
+
+        for idx, name in ((1, "w"), (3, "wp")):
+            ref = np.asarray(truth[idx], np.float64)
+            peak = np.abs(ref).max()
+            err_old = np.abs(np.asarray(old[idx], np.float64)
+                             - ref).max() / peak
+            err_new = np.abs(np.asarray(new[idx], np.float64)
+                             - ref).max() / peak
+            # measured: dw 4.0e-3 -> 0.9e-3, dwp 5.8e-3 -> 2.1e-3
+            assert err_new < 0.6 * err_old, (name, err_old, err_new)
+
+    def test_trace_records_and_hbm_accounting(self, args):
+        """The cost-model hook: a pallas call records its signature,
+        and the analytic kernel bytes beat the scan's T x re-fetch
+        story at the flagship (hand-checked terms)."""
+        pallas_lstm.reset_trace_records()
+        jax.jit(lambda *a: pallas_lstm.lstm_scan(
+            *a, impl="pallas"))(*args)
+        (rec,) = pallas_lstm.trace_records(None)
+        assert (rec["T"], rec["B"], rec["E"], rec["H"], rec["P"]) == \
+            (T, B, E, H, P)
+        assert rec["n_shards"] == 1 and rec["bwd"] == "scan"
+
+        # flagship per-chip accounting (bf16, dp=8): kernel fwd+bwd
+        # must be far under the scan path's 3x T-fold weight re-fetch
+        FT, FB = 20, 128
+        FE, FH, FP = 512, 2048, 512
+        acct = pallas_lstm.kernel_hbm_bytes(FT, FB, FE, FH, FP, 2, 2,
+                                            bwd="kernel")
+        # hand-checked: resident = 2 x (w_h + w_proj) bf16 = 21.0 MB
+        assert acct["resident_bytes_per_device"] == \
+            2 * (FP * 4 * FH + FH * FP) * 2
+        scan = pallas_lstm.scan_hbm_bytes(FT, FB, FE, FH, FP, 2, 2)
+        kern = acct["stream_bytes"] + acct["resident_bytes_per_device"]
+        assert kern < 0.5 * scan, (kern, scan)
+
+    def test_costmodel_prices_kernel_records(self):
+        """tune/costmodel.predict folds the kernel bytes into the HBM
+        roofline: stream bytes split across devices, resident bytes
+        paid per device."""
+        from parallax_tpu.tune import costmodel
+        base = costmodel.CostInputs(flops=0.0, hbm_bytes=0.0)
+        with_k = costmodel.CostInputs(
+            flops=0.0, hbm_bytes=0.0,
+            lstm_stream_bytes=8e6, lstm_resident_bytes=1e6)
+        plan = costmodel.Plan(dp=2, tp=4)
+        c0 = costmodel.predict(plan, base)
+        c1 = costmodel.predict(plan, with_k)
+        n = plan.num_devices
+        bps = costmodel.NOMINAL_HBM_BPS
+        want = (8e6 + 1e6 * n) / (n * bps)
+        assert abs(c1.terms["hbm_s"] - want) < 1e-15
+        assert abs(c1.terms["hbm_lstm_kernel_s"] - want) < 1e-15
+        assert c0.terms["hbm_s"] == 0.0
+
+    def test_lm1b_pallas_step_remat_free_under_emittable_plans(
+            self, capfd):
+        """The trained LM1B step with lstm_impl='pallas' compiles with
+        ZERO GSPMD involuntary rematerialization under every plan the
+        tuner can emit (the dryrun phase-6b gate, tier-1-sized:
+        compile only, no execution)."""
+        from parallax_tpu.common.config import ParallaxConfig
+        from parallax_tpu.core import engine as engine_lib
+        from parallax_tpu.core import mesh as mesh_lib
+        from parallax_tpu.models import lm1b
+        from parallax_tpu.tune.search import emittable_plans
+
+        devices = jax.devices()[:8]
+        cfg = lm1b.tiny_config(num_partitions=8, lstm_impl="pallas")
+        model = lm1b.build_model(cfg)
+        batch = lm1b.make_batch(np.random.default_rng(5), 8, 4,
+                                cfg.vocab_size)
+        for plan in emittable_plans(8):
+            config = ParallaxConfig(run_option=plan.run_option,
+                                    search_partitions=False)
+            mesh = mesh_lib.build_mesh(devices,
+                                       shape=(plan.dp, plan.tp))
+            eng = engine_lib.Engine(model, mesh, config, batch)
+            state_shapes = jax.eval_shape(
+                eng._init_jit, jax.ShapeDtypeStruct((), jnp.int32))
+            capfd.readouterr()                          # drain
+            eng._step_jit.lower(state_shapes,
+                                eng._batch_shapes).compile()
+            err = capfd.readouterr().err
+            assert "Involuntary full rematerialization" not in err, (
+                plan.describe(), err[-2000:])

@@ -197,6 +197,35 @@ class TestHloIndex:
         assert a.dense_sparse["unmapped_self_ms"] == \
             pytest.approx(0.180, abs=1e-6)
 
+    def test_direction_of_transpose_scopes(self):
+        """ISSUE 14 backward-attribution join: XLA's AD-transpose
+        scope marks the backward; jit wrappers and op names merely
+        CONTAINING 'transpose' (the copy-category opcode) don't."""
+        assert xprof.direction_of(
+            {"op_name": "jit(s)/transpose(jvp(f))/mul"}) == "backward"
+        assert xprof.direction_of(
+            {"op_name": "jit(s)/jit(main)/lstm/dot"}) == "forward"
+        # an op NAMED transpose is a forward copy, not the backward
+        assert xprof.direction_of(
+            {"op_name": "jit(s)/layer_a/transpose"}) == "forward"
+        assert xprof.direction_of({"opcode": "dot"}) is None
+        assert xprof.direction_of(None) is None
+
+    def test_attribution_fwd_bwd_split(self):
+        idx = {"dot.1": {"opcode": "dot",
+                         "op_name":
+                         "jit(s)/transpose(jvp(step))/layer_a/dot"}}
+        a = xprof.attribute(_golden(), steps=2, hlo_index=idx)
+        assert a.fwd_bwd["backward_self_ms"] == \
+            pytest.approx(0.080, abs=1e-6)
+        assert a.fwd_bwd["forward_self_ms"] == 0.0
+        assert a.fwd_bwd["unmapped_self_ms"] == \
+            pytest.approx(0.180, abs=1e-6)
+        # no index: everything unmapped, never fabricated
+        a0 = xprof.attribute(_golden(), steps=2)
+        assert a0.fwd_bwd["forward_self_ms"] == 0.0
+        assert a0.fwd_bwd["backward_self_ms"] == 0.0
+
 
 # -- calibration store ------------------------------------------------------
 

@@ -86,6 +86,53 @@ def test_pallas_lstm_flagship_lowers_for_tpu():
         x, w, b, wp, impl="pallas", interpret=False), *args)
 
 
+def test_pallas_lstm_bwd_lowers_for_tpu():
+    """ISSUE 14: the time-reversed backward kernel at the flagship
+    shape — residual-saving forward + backward recurrence both lower
+    through Mosaic (reversed/clamped index maps, resident transposed
+    matmuls, fp32 carry scratch). Exactly two custom calls: the
+    hoisted/epilogue matmuls are plain XLA by design."""
+    T_, B_ = 4, 128
+    E, H_, P = 512, 2048, 512
+    args = (jax.ShapeDtypeStruct((T_, B_, E), jnp.bfloat16),
+            jax.ShapeDtypeStruct((E + P, 4 * H_), jnp.bfloat16),
+            jax.ShapeDtypeStruct((4 * H_,), jnp.bfloat16),
+            jax.ShapeDtypeStruct((H_, P), jnp.bfloat16))
+
+    def fwd_bwd(x, w, b, wp):
+        return jax.grad(lambda *a: jnp.sum(pallas_lstm.lstm_scan(
+            *a, impl="pallas", bwd_impl="kernel",
+            interpret=False).astype(jnp.float32)),
+            argnums=(0, 1, 2, 3))(x, w, b, wp)
+    text = _export_tpu(fwd_bwd, *args)
+    assert text.count("tpu_custom_call") == 2, text.count(
+        "tpu_custom_call")
+
+
+def test_pallas_lstm_recompute_fallback_lowers_for_tpu():
+    """The refusal/size-guard fallback must stay TPU-lowerable too:
+    forced recompute keeps ONE custom call (the primal-only forward —
+    no residual streams; value_and_grad keeps the primal live, grad
+    alone would DCE the forward) next to the pure-XLA transposed
+    scan."""
+    T_, B_ = 4, 128
+    E, H_, P = 512, 2048, 512
+    args = (jax.ShapeDtypeStruct((T_, B_, E), jnp.bfloat16),
+            jax.ShapeDtypeStruct((E + P, 4 * H_), jnp.bfloat16),
+            jax.ShapeDtypeStruct((4 * H_,), jnp.bfloat16),
+            jax.ShapeDtypeStruct((H_, P), jnp.bfloat16))
+
+    def fwd_bwd(x, w, b, wp):
+        return jax.value_and_grad(
+            lambda *a: jnp.sum(pallas_lstm.lstm_scan(
+                *a, impl="pallas", bwd_impl="recompute",
+                interpret=False).astype(jnp.float32)),
+            argnums=(0, 1, 2, 3))(x, w, b, wp)
+    text = _export_tpu(fwd_bwd, *args)
+    assert text.count("tpu_custom_call") == 1, text.count(
+        "tpu_custom_call")
+
+
 def test_hybrid_engine_step_lowers_for_tpu():
     """The WHOLE flagship-path training step — hybrid plan, slices
     sparse grads, 8-device (repl x shard) mesh — lowers for a TPU

@@ -1,0 +1,251 @@
+"""LM1B LSTM training-step fwd+bwd A/B: pallas backward vs recompute.
+
+ISSUE 14 acceptance rig: times the flagship recurrence's forward +
+backward under three backends — the VMEM-resident pallas backward
+kernel (``bwd_impl='kernel'``), the recompute-XLA VJP it replaced
+(``bwd_impl='recompute'``, the r13 behavior and today's refusal
+fallback), and the plain XLA scan (``impl='xla'``) — at the op level
+(clean signal) AND through one real ``parallel_run`` LM1B training
+step (the end-to-end number the headline tracks). The analytic
+fwd+bwd HBM-bytes story at the true flagship shape rides along
+(``ops/pallas_lstm.kernel_hbm_bytes`` / ``scan_hbm_bytes`` — exact
+byte accounting, not a measurement).
+
+HONESTY: on the CPU rig the pallas kernels run in interpret mode, so
+the measured ratios price the *interpreter emulation*, not the
+TPU memory system the kernel exists for — every ratio is stamped
+CPU-relative and the regression gate tracks cross-round DRIFT of this
+rig's numbers, never the absolute. The HBM-bytes block is the
+hardware claim; the step_ms block is this rig's trajectory.
+
+Keys consumed by bench.py's ``lstm`` block and gated by
+tools/check_regression.py: ``op_ms.pallas_bwd`` and
+``pallas_over_recompute`` (lower is better for both).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# op-level A/B shape: flagship-proportioned (H = 4E, P = E) but sized
+# so the CPU interpreter finishes in seconds; T matches the flagship's
+# 20 so the recompute path pays a real T-fold re-walk
+OP_SHAPE = dict(T=20, B=32, E=64, H=256, P=64)
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def measure_op(repeats: int = 7, shape=None):
+    """Median fwd+bwd wall ms of one op-level training step (loss =
+    weighted sum of hs; grads wrt all four params) per backend.
+
+    PARALLAX_LSTM_BWD is snapshotted and CLEARED for the duration:
+    the env override outranks the bwd_impl argument, so an ambient
+    setting (the documented operational escape hatch) would silently
+    collapse every A/B variant onto one backward and feed the drift
+    gate a fake ~1.0 ratio."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from parallax_tpu.ops import pallas_lstm
+
+    prior = os.environ.pop("PARALLAX_LSTM_BWD", None)
+    try:
+        return _measure_op(jax, jnp, np, pallas_lstm, repeats, shape)
+    finally:
+        if prior is not None:
+            os.environ["PARALLAX_LSTM_BWD"] = prior
+
+
+def _measure_op(jax, jnp, np, pallas_lstm, repeats, shape):
+
+    s = dict(OP_SHAPE, **(shape or {}))
+    T, B, E, H, P = s["T"], s["B"], s["E"], s["H"], s["P"]
+    rng = np.random.default_rng(0)
+
+    def t(shp, sc=0.2):
+        return jnp.asarray(rng.standard_normal(shp) * sc, jnp.float32)
+    args = (t((T, B, E)), t((E + P, 4 * H)), t((4 * H,), 0.0),
+            t((H, P)))
+    g_out = t((T, B, P))
+
+    def grad_fn(impl, **kw):
+        # value_and_grad, not grad: a training step consumes the loss,
+        # so the forward must stay live — under grad alone XLA DCEs
+        # the recompute variant's pallas forward entirely (its
+        # recomputed scan IS its forward) and the A/B would compare a
+        # bwd-only program against fwd+bwd ones
+        return jax.jit(jax.value_and_grad(
+            lambda x, w, b, wp: jnp.sum(pallas_lstm.lstm_scan(
+                x, w, b, wp, impl=impl, **kw) * g_out),
+            argnums=(0, 1, 2, 3)))
+
+    variants = {
+        "pallas_bwd": grad_fn("pallas", bwd_impl="kernel"),
+        # the shipped default: kernel on TPU, residual-scan executor
+        # off-TPU (same algorithm, no interpreter tax, no recompute)
+        "auto": grad_fn("pallas", bwd_impl="auto"),
+        "recompute": grad_fn("pallas", bwd_impl="recompute"),
+        "xla": grad_fn("xla"),
+    }
+
+    def timed(fn):
+        jax.block_until_ready(fn(*args))               # compile
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            times.append((time.perf_counter() - t0) * 1e3)
+        return round(_median(times), 3)
+
+    out = {name: timed(fn) for name, fn in variants.items()}
+    # the interpreter-tax witness: forward-only pallas vs forward-only
+    # XLA scan at the same shape. Off-TPU the pallas programs run in
+    # interpret mode, and this ratio IS that emulation's constant
+    # factor — it explains in-artifact why pallas_over_recompute can
+    # read > 1 on the CPU rig while the analytic HBM story (the thing
+    # the kernel exists for) says < 0.2x on hardware.
+    fwd = {
+        "pallas": timed(jax.jit(lambda x, w, b, wp:
+                                pallas_lstm.lstm_scan(
+                                    x, w, b, wp, impl="pallas"))),
+        "xla": timed(jax.jit(lambda x, w, b, wp:
+                             pallas_lstm.lstm_scan(
+                                 x, w, b, wp, impl="xla"))),
+    }
+    tax = (round(fwd["pallas"] / fwd["xla"], 3) if fwd["xla"]
+           else None)
+    return out, s, fwd, tax
+
+
+def measure_train(steps: int = 8, warmup: int = 2):
+    """One real LM1B training step (parallel_run, HYBRID, tiny config,
+    lstm_impl='pallas') timed with the kernel backward vs the forced
+    recompute fallback (PARALLAX_LSTM_BWD env — consulted at trace
+    time, so each session re-traces under its own setting)."""
+    import jax
+    import numpy as np
+    import parallax_tpu as parallax
+    from parallax_tpu.models import lm1b
+
+    n = jax.device_count()
+    out = {}
+    prior = os.environ.get("PARALLAX_LSTM_BWD")
+    for name, env in (("auto", "auto"), ("pallas_bwd", "kernel"),
+                      ("recompute", "recompute")):
+        os.environ["PARALLAX_LSTM_BWD"] = env
+        try:
+            cfg = lm1b.tiny_config(num_partitions=n,
+                                   lstm_impl="pallas",
+                                   compute_dtype=np.float32)
+            sess, *_ = parallax.parallel_run(
+                lm1b.build_model(cfg),
+                parallax_config=parallax.Config(
+                    run_option="HYBRID", search_partitions=False))
+            try:
+                rng = np.random.default_rng(0)
+                batch = lm1b.make_batch(rng, 8 * n, 8, cfg.vocab_size)
+                for _ in range(warmup):
+                    float(sess.run("loss", feed_dict=batch))
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    float(sess.run("loss", feed_dict=batch))
+                out[name] = round(
+                    (time.perf_counter() - t0) / steps * 1e3, 3)
+            finally:
+                sess.close()
+        finally:
+            # restore the caller's setting, never just erase it
+            if prior is None:
+                os.environ.pop("PARALLAX_LSTM_BWD", None)
+            else:
+                os.environ["PARALLAX_LSTM_BWD"] = prior
+    return out
+
+
+def flagship_hbm_story(n_chips: int = 8):
+    """The analytic per-chip fwd+bwd HBM bytes at the TRUE flagship
+    (bf16, global B = 128 x chips, T=20) — kernel path vs the XLA
+    scan + recompute-VJP alternative. Exact byte accounting from the
+    kernel's own block/stream structure; the hardware claim the
+    measured CPU ratios cannot make."""
+    from parallax_tpu.ops import pallas_lstm
+
+    T, Bc, E, H, P = 20, 128, 512, 2048, 512
+    kern = pallas_lstm.kernel_hbm_bytes(T, Bc, E, H, P, 2, 2,
+                                        bwd="kernel")
+    kern_total = (kern["stream_bytes"]
+                  + kern["resident_bytes_per_device"])
+    scan_total = pallas_lstm.scan_hbm_bytes(T, Bc, E, H, P, 2, 2,
+                                            training=True)
+    return {
+        "shape": {"T": T, "B_per_chip": Bc, "E": E, "H": H, "P": P,
+                  "dtype": "bfloat16", "n_chips": n_chips},
+        "kernel_fwd_bwd_bytes_per_chip": kern_total,
+        "scan_recompute_bytes_per_chip": scan_total,
+        "kernel_over_scan": round(kern_total / scan_total, 4),
+        "basis": ("analytic recurrence-traffic accounting (exact for "
+                  "the kernel's stream/resident structure); both "
+                  "sides exclude the dW-accumulation streams each "
+                  "path additionally pays and the hoisted x@w_x both "
+                  "share; not a measurement"),
+    }
+
+
+def measure(train: bool = True):
+    import jax
+
+    op_ms, shape, fwd_only, tax = measure_op()
+    on_cpu = jax.devices()[0].platform == "cpu"
+    rec = {
+        "platform": jax.devices()[0].platform,
+        "op_shape": shape,
+        "op_ms": op_ms,
+        "pallas_over_recompute": (
+            round(op_ms["pallas_bwd"] / op_ms["recompute"], 4)
+            if op_ms.get("recompute") else None),
+        # the shipped-default backward (kernel on TPU, residual-scan
+        # off-TPU) vs the r13 recompute baseline — the rig-honest
+        # fwd+bwd win: < 1 means the residual design beats recompute
+        # on THIS rig with THIS executor
+        "auto_over_recompute": (
+            round(op_ms["auto"] / op_ms["recompute"], 4)
+            if op_ms.get("recompute") else None),
+        "fwd_only_ms": fwd_only,
+        "interpret_tax": tax,
+        "hbm_bytes_flagship": flagship_hbm_story(jax.device_count()),
+        "note": ("CPU rig runs the kernels in interpret mode: the "
+                 "measured ratios price the interpreter emulation "
+                 "(interpret_tax is the witness — the fwd-only pallas "
+                 "vs XLA ratio), NOT the HBM economics the kernel "
+                 "exists for; cross-round DRIFT is the gated signal "
+                 "and the analytic hbm_bytes_flagship block is the "
+                 "hardware claim" if on_cpu
+                 else "measured on accelerator"),
+    }
+    if train:
+        try:
+            rec["train_step_ms"] = measure_train()
+            tr = rec["train_step_ms"]
+            if tr.get("recompute"):
+                rec["train_pallas_over_recompute"] = round(
+                    tr["pallas_bwd"] / tr["recompute"], 4)
+                rec["train_auto_over_recompute"] = round(
+                    tr["auto"] / tr["recompute"], 4)
+        except Exception as e:
+            rec["train_step_ms"] = None
+            rec["train_error"] = f"{type(e).__name__}: {e}"[:200]
+    return rec
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps(measure(), indent=2))

@@ -237,6 +237,20 @@ SECONDARY_GATES = (
     ("profile.attribution_coverage", True),
     ("profile.calibration.wire_predicted_over_measured", False),
     ("profile.calibration.wire_predicted_over_measured", True),
+    # pallas LSTM backward (ISSUE 14, bench "lstm" block): the
+    # fwd+bwd op step must not quietly slow down, and the
+    # pallas-over-recompute ratio is gated in BOTH directions — the
+    # two-row two-sided drift pattern (the absolute is CPU-relative
+    # on the CPU rig, where it prices the interpreter emulation, not
+    # the kernel's HBM economics; a drifting ratio means one of the
+    # two backward paths moved)
+    ("lstm.op_ms.pallas_bwd", False),
+    ("lstm.pallas_over_recompute", False),
+    ("lstm.pallas_over_recompute", True),
+    # the shipped-default backward's win over the recompute baseline
+    # (kernel on TPU, residual-scan off-TPU) — a ratio creeping back
+    # toward 1 means the residual backward is losing its edge
+    ("lstm.auto_over_recompute", False),
 )
 
 

@@ -21,22 +21,31 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 TRACED_STEPS = 8
 
 
-def run_trace(outdir: str) -> None:
+def run_trace(outdir: str):
+    """Returns the compiled step's HLO index (obs/xprof) so the
+    summary can join trace op names back to model scopes — the
+    layer / dense-sparse / fwd-bwd attribution rows."""
     import jax
     import numpy as np
     import parallax_tpu as parallax
     from parallax_tpu.models import lm1b
+    from parallax_tpu.obs import xprof
 
     n_chips = jax.device_count()
     platform = jax.devices()[0].platform
     mode = os.environ.get("PARALLAX_PROFILE_GRAD_MODE", "slices")
+    # 'pallas' profiles the flagship's kernel-served recurrence
+    # (ISSUE 14); default keeps the historical xla scan
+    lstm_impl = os.environ.get("PARALLAX_PROFILE_LSTM_IMPL", "xla")
     if platform == "cpu":
         cfg = lm1b.tiny_config(num_partitions=n_chips,
-                               sparse_grad_mode=mode)
+                               sparse_grad_mode=mode,
+                               lstm_impl=lstm_impl)
         bs, T = 16 * n_chips, 8
     else:
         cfg = lm1b.LM1BConfig(num_partitions=n_chips,
-                              sparse_grad_mode=mode)
+                              sparse_grad_mode=mode,
+                              lstm_impl=lstm_impl)
         bs, T = 128 * n_chips, 20
     sess, *_ = parallax.parallel_run(
         lm1b.build_model(cfg),
@@ -59,15 +68,19 @@ def run_trace(outdir: str) -> None:
     jax.block_until_ready(sess.state.params)
     print(f"# step time (untraced): "
           f"{(time.perf_counter() - t0) / 10 * 1e3:.1f} ms "
-          f"({platform}, bs={bs}, T={T})")
+          f"({platform}, bs={bs}, T={T}, lstm_impl={lstm_impl})")
+    hlo_index = xprof.engine_hlo_index(sess.engine)
     sess.close()
+    return hlo_index
 
 
-def summarize(outdir: str, top: int = 25) -> None:
+def summarize(outdir: str, top: int = 25, hlo_index=None) -> None:
     """Shared-parser summary (obs/xprof): top ops by SELF duration
     (nesting resolved, unlike the old inline aggregation that counted
-    a while loop and its body twice), the category split, and the
-    coverage/residual account."""
+    a while loop and its body twice), the category split, the
+    coverage/residual account, and — with an ``hlo_index`` — the
+    forward/backward attribution row (ISSUE 14: where the training
+    step's backward actually goes) plus the per-op LSTM rows."""
     from parallax_tpu.obs import xprof
 
     try:
@@ -76,7 +89,7 @@ def summarize(outdir: str, top: int = 25) -> None:
         print("no trace.json(.gz) found under", outdir)
         return
     attrib = xprof.attribute(trace, steps=TRACED_STEPS, top=top,
-                             source=path)
+                             hlo_index=hlo_index, source=path)
     print(f"# {attrib.events} device op event(s) on {attrib.tracks} "
           f"track(s) [{attrib.track_basis}]")
     if attrib.coverage is not None:
@@ -87,6 +100,19 @@ def summarize(outdir: str, top: int = 25) -> None:
     for cat, row in attrib.by_category.items():
         print(f"# {cat:<11} {row['self_ms']:9.2f} ms  "
               f"share {row['share']:.3f}  x{row['events']}")
+    # backward-attribution row (ISSUE 14): fwd-vs-bwd self-time from
+    # the HLO op_name transpose(...) scopes; all-unmapped when no
+    # hlo_index was joinable (visible, never fabricated)
+    fb = attrib.fwd_bwd or {}
+    total = sum(fb.values()) or 1.0
+    print("# fwd/bwd     "
+          + "  ".join(f"{k.replace('_self_ms', '')} "
+                      f"{v:.2f} ms ({v / total:.0%})"
+                      for k, v in fb.items()))
+    lstm_layers = {k: v for k, v in attrib.layers.items()
+                   if "lstm" in k.lower()}
+    for layer, v in lstm_layers.items():
+        print(f"# lstm layer  {layer:<40} {v:9.2f} ms")
     width = max((len(r["op"]) for r in attrib.top_ops), default=10)
     for r in attrib.top_ops:
         print(f"{r['op'][:90]:<{min(width, 90)}}  "
@@ -96,5 +122,5 @@ def summarize(outdir: str, top: int = 25) -> None:
 
 if __name__ == "__main__":
     outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/lm1b_profile"
-    run_trace(outdir)
-    summarize(outdir)
+    index = run_trace(outdir)
+    summarize(outdir, hlo_index=index)
