@@ -564,6 +564,37 @@ def worker_main():
                     fres["bench"],
                     ok=not fviol,
                     violations=fviol[:3] or None)
+            # Prefix-reuse block (ISSUE 15): the radix-cache guard run
+            # end to end at 50% shared-prefix load — warm-vs-cold TTFT
+            # p50, tokens/sec with sharing on, hit rate, evictions and
+            # the exact-reuse/leak/isolation verdicts, per round.
+            # serve.prefix.ttft_ms_p50_warm and .hit_rate are
+            # secondary-gated (tools/check_regression.py); no
+            # BENCH_VERSION bump (additive block, gates skip when
+            # absent). PARALLAX_BENCH_PREFIX=0 skips.
+            if os.environ.get("PARALLAX_BENCH_PREFIX", "1") != "0":
+                from tools import check_prefix_reuse
+                pres = check_prefix_reuse.measure(
+                    n_requests=30, prefix_share=0.5)
+                pviol = check_prefix_reuse.check(pres)
+                serve_snap["prefix"] = {
+                    "prefix_share": pres["prefix_share"],
+                    "ttft_ms_p50_warm": pres["ttft_ms_p50_warm"],
+                    "ttft_ms_p50_cold": pres[
+                        "ttft_ms_p50_cold_nosharing"],
+                    "tokens_per_sec_warm": pres["tokens_per_sec_warm"],
+                    "tokens_per_sec_nosharing": pres[
+                        "tokens_per_sec_nosharing"],
+                    "hit_rate": pres["hit_rate"],
+                    "full_hits": pres["full_hits"],
+                    "cow_copies": pres["cow_copies"],
+                    "evictions": pres["evictions"],
+                    "token_mismatches": pres["token_mismatches"],
+                    "tenant_isolation_clean": pres[
+                        "tenant_isolation"].get("b_hits_delta") == 0,
+                    "ok": not pviol,
+                    "violations": pviol[:3] or None,
+                }
         except Exception as e:
             print(f"# serve bench failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)

@@ -400,6 +400,38 @@ class ServeConfig:
     * ``drain_timeout_s``: ``close()`` stops admission and serves the
       already-accepted queue to completion, up to this long; whatever
       is still queued after it is failed with ``ServeClosed``.
+    * ``prefix_cache``: enable prefix-aware KV reuse (ISSUE 15,
+      serve/prefixcache.py) on the paged continuous-decode path:
+      finished sequences are indexed by token prefix in a per-tenant
+      radix cache, identical requests replay cached tokens and map the
+      cached pages read-only (copy-on-write at the divergence
+      boundary), and pool exhaustion evicts LRU unpinned cached
+      prefixes before deferring. Requires a paged program; ignored by
+      one-shot sessions.
+    * ``prefix_cache_max_pages``: bound on pool pages the prefix cache
+      may hold (best effort — pinned entries are never evicted);
+      None = bounded only by pool-exhaustion eviction.
+    * ``prefix_cache_max_entries``: bound on cached ENTRIES. Each
+      entry also pins its prefill request state — device arrays the
+      page accounting cannot see (for the NMT adapter,
+      ``2 * num_layers * max_src_len * model_dim`` cross-K/V values
+      per entry) — so workloads with long sources and short decodes
+      should cap entries, not just pages. None = unbounded count.
+    * ``tenant_quotas`` / ``default_tenant_quota``: per-tenant
+      admission quotas — a tenant's admitted-but-unfinished requests
+      are capped at its quota (``tenant_quotas[tenant]``, else
+      ``default_tenant_quota``, else unlimited), shed with
+      ``TenantQuotaExceeded`` (a retryable ``ServeOverloaded``). The
+      cap is also the fairness floor: a noisy tenant cannot consume
+      the capacity other tenants' quotas entitle them to.
+    * ``slo_classes``: named service classes, ``{name: {"priority":
+      int, "deadline_ms": float | None}}``. ``submit(slo_class=...)``
+      requests inherit the class deadline when the caller passes
+      none; in CONTINUOUS-DECODE mode the queue additionally serves
+      lower priority ranks first (FIFO within a class). One-shot
+      batch formation stays FIFO/group-keyed — there the class
+      contributes its deadline only. Unknown class names are refused
+      at submit.
     """
 
     max_batch: int = 8
@@ -409,6 +441,12 @@ class ServeConfig:
     batch_buckets: Optional[Sequence[int]] = None
     length_buckets: Optional[Sequence[int]] = None
     drain_timeout_s: float = 30.0
+    prefix_cache: bool = False
+    prefix_cache_max_pages: Optional[int] = None
+    prefix_cache_max_entries: Optional[int] = None
+    tenant_quotas: Optional[Dict[Any, int]] = None
+    default_tenant_quota: Optional[int] = None
+    slo_classes: Optional[Dict[str, Dict[str, Any]]] = None
 
     def __post_init__(self):
         if int(self.max_batch) < 1:
@@ -441,6 +479,49 @@ class ServeConfig:
                 f"serve batch_buckets {self.batch_buckets} do not cover "
                 f"max_batch={self.max_batch}; the largest bucket must "
                 f"fit a full batch")
+        for name in ("prefix_cache_max_pages",
+                     "prefix_cache_max_entries"):
+            v = getattr(self, name)
+            if v is not None and int(v) < 0:
+                raise ValueError(
+                    f"serve {name} must be >= 0, got {v}")
+        for name, q in (self.tenant_quotas or {}).items():
+            if int(q) < 1:
+                raise ValueError(
+                    f"serve tenant quota for {name!r} must be >= 1, "
+                    f"got {q}")
+        if self.default_tenant_quota is not None \
+                and int(self.default_tenant_quota) < 1:
+            raise ValueError(
+                f"serve default_tenant_quota must be >= 1, got "
+                f"{self.default_tenant_quota}")
+        for name, cls in (self.slo_classes or {}).items():
+            if not isinstance(cls, dict) or "priority" not in cls:
+                raise ValueError(
+                    f"serve slo_classes[{name!r}] must be a dict with "
+                    f"a 'priority' key, got {cls!r}")
+            ddl = cls.get("deadline_ms")
+            if ddl is not None and float(ddl) <= 0:
+                raise ValueError(
+                    f"serve slo_classes[{name!r}] deadline_ms must be "
+                    f"> 0 or None, got {ddl}")
+
+    def resolve_slo_class(self, name: Optional[str]):
+        """``(priority_rank, class_deadline_ms)`` for an SLO class
+        name (rank 0 / no deadline for None); unknown names are
+        refused loudly — a typo'd class silently served best-effort
+        would be an SLO hole."""
+        if name is None:
+            return 0, None
+        classes = self.slo_classes or {}
+        if name not in classes:
+            raise ValueError(
+                f"unknown slo_class {name!r}; declared: "
+                f"{sorted(classes) or '(none)'}")
+        cls = classes[name]
+        ddl = cls.get("deadline_ms")
+        return int(cls["priority"]), (float(ddl) if ddl is not None
+                                      else None)
 
     def resolved_batch_buckets(self) -> tuple:
         """Declared buckets, or doubling sizes 1,2,4,... up to (and

@@ -25,11 +25,16 @@ Two classes:
   Phases: ``admission`` (submit-side validation/padding),
   ``queue_wait`` (enqueue -> popped by a serving loop), ``prefill``
   (pop -> slot activation; per-chunk durations in
-  ``prefill_chunks_ms``), ``slot_wait`` (page-pool-exhausted refill
+  ``prefill_chunks_ms``), ``prefix_replay`` (the prefix-cache hit's
+  substitute for prefill: cached tokens/pages mapped instead of
+  computed — ISSUE 15), ``slot_wait`` (page-pool-exhausted refill
   deferrals), ``decode`` (activation -> retire), ``service`` (the
   one-shot batcher's dispatch+infer+split), ``failover`` (replica
   death -> re-placement). Alongside: the replica hop trail, retries
-  consumed, KV pages held, decode-step count and token count.
+  consumed, KV pages held, decode-step count, token count, and the
+  prefix-reuse pair ``prefix_hit_pages`` / ``prefill_tokens_skipped``
+  (cached KV pages this request did not write / source tokens whose
+  prefill it skipped).
 
 * :class:`RequestTraceRing` — a bounded ring of completed records,
   exported three ways at ~zero per-request cost (the PR 5 pattern:
@@ -67,9 +72,15 @@ from parallax_tpu.obs.metrics import (MetricsRegistry, nearest_rank,
                                       summarize_window)
 
 # the attributed request phases, in lifecycle order (bare names; the
-# registry gauges and ttft_decomp keys carry the _ms suffix)
-PHASES = ("admission", "queue_wait", "prefill", "slot_wait", "decode",
-          "service", "failover")
+# registry gauges and ttft_decomp keys carry the _ms suffix).
+# ``prefix_replay`` (ISSUE 15) is the prefix-cache hit's substitute for
+# ``prefill``: the window between pop and activation when cached
+# tokens/pages were mapped instead of computing — near-zero by design,
+# and its EXPLICIT presence in the TTFT decomposition (next to the
+# record's ``prefill_tokens_skipped`` count) is what attributes the
+# skipped prefill rather than leaving a hole in the timeline.
+PHASES = ("admission", "queue_wait", "prefill", "prefix_replay",
+          "slot_wait", "decode", "service", "failover")
 
 DEFAULT_CAPACITY = 512
 
@@ -89,6 +100,7 @@ class RequestRecord:
     __slots__ = ("key", "t0", "deadline_ms", "fleet_owned",
                  "phases", "segments", "prefill_chunks_ms", "hops",
                  "retries", "kv_pages", "decode_steps", "tokens",
+                 "prefix_hit_pages", "prefill_tokens_skipped",
                  "ttft_ms", "ttft_decomp", "total_ms", "outcome",
                  "n_marks", "_phase", "_t", "_ring", "_lock", "_done")
 
@@ -108,6 +120,12 @@ class RequestRecord:
         self.kv_pages = 0
         self.decode_steps = 0
         self.tokens = 0
+        # prefix-cache reuse (ISSUE 15): pool pages of cached KV this
+        # request did NOT have to write, and source tokens whose
+        # prefill it skipped (0/0 on a cache miss or with the cache
+        # off)
+        self.prefix_hit_pages = 0
+        self.prefill_tokens_skipped = 0
         self.ttft_ms: Optional[float] = None
         self.ttft_decomp: Optional[Dict[str, float]] = None
         self.total_ms: Optional[float] = None
@@ -253,6 +271,8 @@ class RequestRecord:
                 "kv_pages": self.kv_pages,
                 "decode_steps": self.decode_steps,
                 "tokens": self.tokens,
+                "prefix_hit_pages": self.prefix_hit_pages,
+                "prefill_tokens_skipped": self.prefill_tokens_skipped,
                 "ttft_ms": (round(self.ttft_ms, 4)
                             if self.ttft_ms is not None else None),
                 "ttft_decomp": (dict(self.ttft_decomp)
@@ -318,6 +338,12 @@ class RequestTraceRing:
             self._column_fn(lambda r: float(r.decode_steps) or None))
         g(f"{prefix}.kv_pages").set_fn(
             self._column_fn(lambda r: float(r.kv_pages) or None))
+        g(f"{prefix}.prefix_hit_pages").set_fn(
+            self._column_fn(
+                lambda r: float(r.prefix_hit_pages) or None))
+        g(f"{prefix}.prefill_tokens_skipped").set_fn(
+            self._column_fn(
+                lambda r: float(r.prefill_tokens_skipped) or None))
         g(f"{prefix}.hops").set_fn(
             self._column_fn(lambda r: float(len(r.hops)) or None))
         g(f"{prefix}.requests").set_fn(lambda: self._total)

@@ -18,6 +18,13 @@ request path of the ROADMAP north star ("serving heavy traffic"):
     batch's slowest member (Orca-style continuous batching).
   * :mod:`~parallax_tpu.serve.adapters` — DecodeProgram bindings for
     the repo's models (NMT greedy decode).
+  * :mod:`~parallax_tpu.serve.prefixcache` — prefix-aware KV reuse
+    (ISSUE 15): a per-tenant radix index over finished sequences'
+    token prefixes backed by ref-counted pool pages; identical
+    requests replay cached tokens and map shared read-only pages
+    (copy-on-write at the divergence boundary), pool exhaustion
+    evicts LRU unpinned prefixes before deferring, and tenant
+    quotas / SLO classes govern admission.
 
 The fault-tolerant tier above single sessions (ISSUE 7):
 
@@ -48,7 +55,8 @@ from parallax_tpu.serve.adapters import (NMTDecodeProgram,
 from parallax_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
                                         ReplicaUnavailable, Request,
                                         RequestQueue, ServeClosed,
-                                        ServeError, ServeOverloaded)
+                                        ServeError, ServeOverloaded,
+                                        TenantQuotaExceeded)
 from parallax_tpu.serve.continuous import (ContinuousScheduler,
                                            DecodeProgram)
 from parallax_tpu.serve.faults import (FaultInjector, InjectedFault,
@@ -57,6 +65,7 @@ from parallax_tpu.serve.fleet import (FleetConfig, FleetRequest,
                                       ServeFleet)
 from parallax_tpu.serve.paging import (PageAllocator, PagePoolExhausted,
                                        pages_for)
+from parallax_tpu.serve.prefixcache import CacheEntry, RadixPrefixCache
 from parallax_tpu.serve.router import (HealthPolicy, ReplicaHandle,
                                        Router)
 from parallax_tpu.serve.session import ServeSession
@@ -69,5 +78,6 @@ __all__ = [
     "DeadlineExceeded", "ServeClosed", "ReplicaUnavailable",
     "ServeFleet", "FleetConfig", "FleetRequest", "Router",
     "ReplicaHandle", "HealthPolicy", "FaultInjector", "InjectedFault",
-    "ReplicaCrash",
+    "ReplicaCrash", "TenantQuotaExceeded", "RadixPrefixCache",
+    "CacheEntry",
 ]

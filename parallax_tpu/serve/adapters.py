@@ -169,6 +169,8 @@ class NMTDecodeProgram(DecodeProgram):
         self._prefill_jit = jax.jit(self._prefill)
         self._insert_jit = jax.jit(self._insert)
         self._step_jit = jax.jit(self._step)
+        if self.paged:
+            self._copy_page_jit = jax.jit(self._copy_page)
         if self._layer_chunks is not None:
             self._chunk_jits = [
                 jax.jit(functools.partial(self._prefill_embed_chunk,
@@ -202,6 +204,43 @@ class NMTDecodeProgram(DecodeProgram):
         """Pages one request with token cap ``cap`` owns while in
         flight (the scheduler allocates exactly this many at refill)."""
         return pages_for(cap, self.page_size)
+
+    # -- prefix-reuse hooks (ISSUE 15; serve/prefixcache.py) ---------------
+
+    def prefix_key(self, feed) -> tuple:
+        """The radix-cache key of one PREPARED feed: the padded source
+        row as a token tuple. Exact-key semantics are required here —
+        encoder attention is bidirectional, so a shared source PREFIX
+        does not share encoder state; only an identical source does.
+        (Padding is deterministic, so identical sources always collide
+        onto one key; a source that genuinely ends in PAD aliases its
+        trimmed form, which is harmless — ``src_valid`` makes the
+        encodings bit-identical.)"""
+        return tuple(int(t) for t in feed["src"])
+
+    def prefill_tokens(self, feed) -> int:
+        """Source tokens a prefill of ``feed`` would encode — the
+        work a prefix-cache hit skips (``prefill_tokens_skipped``)."""
+        return int((np.asarray(feed["src"]) != self.pad_id).sum())
+
+    def copy_page(self, state, dst, src):
+        """Device-side page copy ``pool[:, dst] <- pool[:, src]`` for
+        the self-KV pool — the copy-on-write primitive: the scheduler
+        calls it before a mapper's first divergent write into a shared
+        partial page, so the cached original is never touched. One
+        jitted signature (dst/src are traced int32 scalars), warmed at
+        scheduler construction like every other device callable."""
+        return self._copy_page_jit(state, jnp.asarray(dst, jnp.int32),
+                                   jnp.asarray(src, jnp.int32))
+
+    def _copy_page(self, state, dst, src):
+        out = dict(state)
+        for name in ("kc", "vc"):
+            pool = state[name]                 # [L, pool, ps, D]
+            page = jax.lax.dynamic_slice_in_dim(pool, src, 1, axis=1)
+            out[name] = jax.lax.dynamic_update_slice_in_dim(
+                pool, page, dst, axis=1)
+        return out
 
     # -- device programs (each jitted once; fixed shapes) ------------------
 

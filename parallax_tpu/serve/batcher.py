@@ -66,6 +66,21 @@ class ServeOverloaded(ServeError):
     retryable = True
 
 
+class TenantQuotaExceeded(ServeOverloaded):
+    """Admission control shed this request because its TENANT is at
+    its admission quota (ISSUE 15): the tenant already has its full
+    allowance of admitted-but-unfinished requests on this replica.
+
+    A subclass of :class:`ServeOverloaded` (same retryable taxonomy —
+    another replica may have quota headroom for this tenant), so every
+    existing shed-handling path treats it correctly; the distinct type
+    and the ``serve.tenant_shed`` counter make quota pressure visible
+    separately from global queue pressure. The quota is also the
+    anti-starvation guarantee in the other direction: a noisy tenant
+    is capped at its own allowance, so it cannot consume the queue
+    capacity other tenants' quotas entitle them to."""
+
+
 class DeadlineExceeded(ServeError):
     """The request's deadline expired before it was served.
 
@@ -117,18 +132,27 @@ class Request:
     """
 
     __slots__ = ("id", "feed", "deadline", "group_key", "max_new_tokens",
-                 "t_enqueue", "t_done", "t_first_token", "rec", "_event",
-                 "_result", "_error", "_callbacks")
+                 "tenant", "slo_rank", "t_enqueue", "t_done",
+                 "t_first_token", "rec", "_event", "_result", "_error",
+                 "_callbacks")
 
     def __init__(self, feed: Dict[str, Any],
                  deadline: Optional[float] = None,
                  group_key: Any = None,
-                 max_new_tokens: Optional[int] = None):
+                 max_new_tokens: Optional[int] = None,
+                 tenant: Any = None,
+                 slo_rank: int = 0):
         self.id = next(_req_ids)
         self.feed = feed
         self.deadline = deadline
         self.group_key = group_key
         self.max_new_tokens = max_new_tokens
+        # multi-tenant admission (ISSUE 15): the tenant this request
+        # bills against (None = the anonymous default tenant) and its
+        # SLO-class priority rank (LOWER serves first; requests of one
+        # rank stay FIFO among themselves)
+        self.tenant = tenant
+        self.slo_rank = int(slo_rank)
         self.t_enqueue = time.perf_counter()
         self.t_done: Optional[float] = None
         self.t_first_token: Optional[float] = None
@@ -214,7 +238,9 @@ class RequestQueue:
     formation; shared by the one-shot micro-batcher and the
     continuous-decode scheduler."""
 
-    def __init__(self, max_queue: int, metrics=None, on_timeout=None):
+    def __init__(self, max_queue: int, metrics=None, on_timeout=None,
+                 tenant_quotas: Optional[Dict[Any, int]] = None,
+                 default_tenant_quota: Optional[int] = None):
         self.max_queue = int(max_queue)
         self._items: List[Request] = []
         self._cond = threading.Condition()
@@ -226,6 +252,20 @@ class RequestQueue:
                           if metrics is not None else None)
         self._shed = (metrics.counter("serve.shed")
                       if metrics is not None else None)
+        # per-tenant admission quotas (ISSUE 15): a tenant's count of
+        # admitted-but-unfinished requests (queued OR in service) is
+        # capped at its quota; the count releases when the request
+        # completes/fails, via its done-callback. None = unlimited.
+        self._tenant_quotas = dict(tenant_quotas or {})
+        self._default_quota = (None if default_tenant_quota is None
+                               else int(default_tenant_quota))
+        self._tenant_outstanding: Dict[Any, int] = {}
+        self._tenant_shed = (metrics.counter("serve.tenant_shed")
+                             if metrics is not None else None)
+        # latched once any request with a nonzero SLO rank is admitted:
+        # rank-free sessions (the overwhelming default) keep pop() at
+        # the old O(1) head-pop instead of paying a priority scan
+        self._ranked_ever = False
         # ``on_timeout(n)``: SLO-breach hook (the serve session points
         # it at the flight recorder). Expiries are detected under the
         # queue lock but reported OUTSIDE it (_report_expired) — the
@@ -245,10 +285,27 @@ class RequestQueue:
         if self._depth is not None:
             self._depth.set(len(self._items))
 
+    def _quota_of(self, tenant) -> Optional[int]:
+        return self._tenant_quotas.get(tenant, self._default_quota)
+
+    def tenant_outstanding(self, tenant) -> int:
+        with self._cond:
+            return self._tenant_outstanding.get(tenant, 0)
+
+    def _release_tenant(self, req: Request) -> None:
+        with self._cond:
+            n = self._tenant_outstanding.get(req.tenant, 0) - 1
+            if n <= 0:
+                self._tenant_outstanding.pop(req.tenant, None)
+            else:
+                self._tenant_outstanding[req.tenant] = n
+
     def put(self, req: Request) -> None:
         """Admit one request; raises :class:`ServeOverloaded` (counted
-        as ``serve.shed``) when the queue is at ``max_queue`` and
-        :class:`ServeClosed` after ``close()``."""
+        as ``serve.shed``) when the queue is at ``max_queue``,
+        :class:`TenantQuotaExceeded` (counted as ``serve.shed`` AND
+        ``serve.tenant_shed``) when the request's tenant is at its
+        admission quota, and :class:`ServeClosed` after ``close()``."""
         with self._cond:
             if self._closed:
                 raise ServeClosed("serve session is closed to new "
@@ -259,6 +316,22 @@ class RequestQueue:
                 raise ServeOverloaded(
                     f"request queue at max_queue={self.max_queue}; "
                     f"request shed")
+            quota = self._quota_of(req.tenant)
+            if quota is not None:
+                held = self._tenant_outstanding.get(req.tenant, 0)
+                if held >= quota:
+                    if self._shed is not None:
+                        self._shed.inc()
+                    if self._tenant_shed is not None:
+                        self._tenant_shed.inc()
+                    raise TenantQuotaExceeded(
+                        f"tenant {req.tenant!r} at admission quota "
+                        f"{quota} ({held} request(s) outstanding); "
+                        f"request shed")
+                self._tenant_outstanding[req.tenant] = held + 1
+                req.add_done_callback(self._release_tenant)
+            if req.slo_rank:
+                self._ranked_ever = True
             self._items.append(req)
             self._set_depth_locked()
             self._cond.notify_all()
@@ -305,8 +378,14 @@ class RequestQueue:
                 pass
 
     def pop(self, timeout: float = 0.05) -> Optional[Request]:
-        """Oldest non-expired request, or None after ``timeout`` (also
-        None immediately when closed and empty)."""
+        """Best non-expired request, or None after ``timeout`` (also
+        None immediately when closed and empty). "Best" is SLO-class
+        order (ISSUE 15): the LOWEST ``slo_rank`` present wins, FIFO
+        within a rank — so a realtime-class request admitted behind a
+        queue of batch-class work is served first, while same-class
+        traffic keeps strict arrival order (a deferred refill put back
+        via :meth:`requeue_front` keeps the head position of its own
+        rank)."""
         end = time.perf_counter() + timeout
         try:
             with self._cond:
@@ -314,7 +393,16 @@ class RequestQueue:
                     now = time.perf_counter()
                     self._shed_expired_locked(now)
                     if self._items:
-                        req = self._items.pop(0)
+                        if self._ranked_ever:
+                            best = min(range(len(self._items)),
+                                       key=lambda i:
+                                       (self._items[i].slo_rank, i))
+                        else:
+                            # no ranked request ever admitted: the
+                            # scan provably returns 0 — skip it (the
+                            # admission hot path is budgeted)
+                            best = 0
+                        req = self._items.pop(best)
                         self._set_depth_locked()
                         return req
                     if self._closed or now >= end:

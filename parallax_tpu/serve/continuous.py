@@ -111,7 +111,8 @@ class DecodeProgram:
 
 
 class _Slot:
-    __slots__ = ("req", "tokens", "t", "cap", "pages")
+    __slots__ = ("req", "tokens", "t", "cap", "pages", "rs", "key",
+                 "entry", "replayed")
 
     def __init__(self, req: Request, cap: int, pages: List[int]):
         self.req = req
@@ -119,20 +120,30 @@ class _Slot:
         self.t = 0
         self.cap = cap
         self.pages = pages
+        # prefix-reuse bookkeeping (ISSUE 15): the prefill request
+        # state (kept so a retiring sequence can be cached), the radix
+        # key, the mapped cache entry (pinned while we run), and how
+        # many of `tokens` were REPLAYED rather than decoded
+        self.rs = None
+        self.key = None
+        self.entry = None
+        self.replayed = 0
 
 
 class _Prefill:
     """One in-flight chunked prefill: the reserved slot, its allocated
     pages, the carry between chunks and the next chunk index."""
 
-    __slots__ = ("req", "slot", "pages", "carry", "k")
+    __slots__ = ("req", "slot", "pages", "carry", "k", "key")
 
-    def __init__(self, req: Request, slot: int, pages: List[int]):
+    def __init__(self, req: Request, slot: int, pages: List[int],
+                 key=None):
         self.req = req
         self.slot = slot
         self.pages = pages
         self.carry = req.feed
         self.k = 0
+        self.key = key
 
 
 class ContinuousScheduler:
@@ -187,12 +198,60 @@ class ContinuousScheduler:
             self._sentinel = int(program.pool_pages)
             self._pages = np.full((self._S, self._P), self._sentinel,
                                   np.int32)
+            # serve.kv_pages_in_use counts each PHYSICAL page once
+            # however many sequences/cache entries map it (the
+            # allocator's distinct-page accounting, ISSUE 15 — naive
+            # per-slot summing would double-count shared pages and
+            # trip the leak checks); the sharing multiplier is its own
+            # gauge family next to it
             self._pages_gauge = metrics.gauge("serve.kv_pages_in_use")
             self._pages_gauge.set(0)
             metrics.gauge("serve.kv_pool_pages").set(self._sentinel)
             self._defer = metrics.counter("serve.kv_refill_deferred")
+            metrics.gauge("serve.kv_page_refs").set_fn(
+                lambda: self._alloc.total_refs)
+            metrics.gauge("serve.kv_shared_pages").set_fn(
+                lambda: self._alloc.shared_pages)
+            metrics.gauge("serve.kv_sharing_ratio").set_fn(
+                lambda: round(self._alloc.sharing_ratio(), 4))
         else:
             self._pages = None
+        # prefix-aware KV reuse (ISSUE 15, serve/prefixcache.py)
+        self._prefix = None
+        if bool(getattr(serve_config, "prefix_cache", False)):
+            if not self._paged or not hasattr(program, "copy_page") \
+                    or not hasattr(program, "prefix_key"):
+                raise ValueError(
+                    "ServeConfig.prefix_cache requires a PAGED "
+                    "DecodeProgram exposing prefix_key/copy_page "
+                    "(page-table indirection is what makes shared "
+                    "read-only pages possible)")
+            from parallax_tpu.serve.prefixcache import RadixPrefixCache
+            self._ps = int(program.page_size)
+            self._prefix = RadixPrefixCache(
+                self._alloc,
+                max_pages=getattr(serve_config,
+                                  "prefix_cache_max_pages", None),
+                max_entries=getattr(serve_config,
+                                    "prefix_cache_max_entries", None))
+            self._pfx_hits = metrics.counter("serve.prefix.hits")
+            self._pfx_misses = metrics.counter("serve.prefix.misses")
+            self._pfx_full = metrics.counter("serve.prefix.full_hits")
+            self._pfx_cow = metrics.counter("serve.prefix.cow_copies")
+            self._pfx_replayed = metrics.counter(
+                "serve.prefix.replayed_tokens")
+            self._pfx_skipped = metrics.counter(
+                "serve.prefix.prefill_tokens_skipped")
+            metrics.gauge("serve.prefix.hit_rate").set_fn(
+                self.prefix_hit_rate)
+            metrics.gauge("serve.prefix.evictions").set_fn(
+                lambda: self._prefix.evictions)
+            metrics.gauge("serve.prefix.cached_pages").set_fn(
+                lambda: self._prefix.cached_pages)
+            metrics.gauge("serve.prefix.entries").set_fn(
+                lambda: self._prefix.num_entries)
+            metrics.gauge("serve.prefix.shared_pages").set_fn(
+                lambda: self._alloc.shared_pages)
         if self._chunks > 1:
             self._chunk_ctr = metrics.counter("serve.prefill_chunks")
         if self._spec:
@@ -263,6 +322,11 @@ class ContinuousScheduler:
             # without this, the first live retire-and-refill pays one
             # serve-time compile
             state = prog.insert(state, np.int32(0), rs)
+            if self._prefix is not None:
+                # the copy-on-write page copy joins the closed
+                # signature set: warmed against the post-insert state
+                # (the state it runs on live, at a cache hit)
+                state = prog.copy_page(state, np.int32(0), np.int32(0))
             jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
         dt = time.perf_counter() - t0
         self.metrics.histogram("serve.compile_seconds").record(dt)
@@ -276,7 +340,8 @@ class ContinuousScheduler:
     # -- admission hooks (called by ServeSession) --------------------------
 
     def make_request(self, feed, deadline,
-                     max_new_tokens: Optional[int]) -> Request:
+                     max_new_tokens: Optional[int],
+                     tenant=None, slo_rank: int = 0) -> Request:
         prog = self._program
         cap = int(max_new_tokens or prog.max_len)
         if cap < 1 or cap > prog.max_len:
@@ -284,7 +349,8 @@ class ContinuousScheduler:
                 f"max_new_tokens={max_new_tokens} outside [1, "
                 f"{prog.max_len}] (the program's decode buffer)")
         return Request(prog.prepare_feed(feed), deadline=deadline,
-                       max_new_tokens=cap)
+                       max_new_tokens=cap, tenant=tenant,
+                       slo_rank=slo_rank)
 
     def kick(self) -> None:
         self._kick.set()
@@ -303,7 +369,37 @@ class ContinuousScheduler:
         prop = self._spec_proposed.value
         return (self._spec_accepted.value / prop) if prop else None
 
+    def prefix_hit_rate(self) -> Optional[float]:
+        if self._prefix is None:
+            return None
+        hits = self._pfx_hits.value
+        lookups = hits + self._pfx_misses.value
+        return (hits / lookups) if lookups else None
+
+    def prefix_stats(self) -> Optional[dict]:
+        """The radix cache's own snapshot (entries / cached pages /
+        pins / per-run insert+evict totals), None without the cache."""
+        return None if self._prefix is None else self._prefix.stats()
+
     # -- paging ------------------------------------------------------------
+
+    def _try_alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh pages, reclaiming from the prefix cache when
+        the pool is exhausted: LRU *unpinned* cached prefixes are
+        evicted until the grant fits (graceful degradation under
+        pressure, ISSUE 15 — the cache is a scavenger of free memory,
+        never a reason to stall admission). None when even eviction
+        cannot free enough (defer)."""
+        try:
+            return self._alloc.alloc(n)
+        except PagePoolExhausted:
+            if self._prefix is not None \
+                    and self._prefix.evict_for(n) > 0:
+                try:
+                    return self._alloc.alloc(n)
+                except PagePoolExhausted:
+                    return None
+            return None
 
     def _alloc_pages(self, req: Request) -> Optional[List[int]]:
         """Pages for one refill, or None to DEFER (pool exhausted —
@@ -311,9 +407,8 @@ class ContinuousScheduler:
         if not self._paged:
             return []
         n = self._program.pages_needed(req.max_new_tokens)
-        try:
-            ids = self._alloc.alloc(n)
-        except PagePoolExhausted:
+        ids = self._try_alloc(n)
+        if ids is None:
             self._defer.inc()
             return None
         self._pages_gauge.set(self._alloc.in_use)
@@ -334,48 +429,187 @@ class ContinuousScheduler:
     # -- refill / prefill --------------------------------------------------
 
     def _activate(self, j: int, req: Request, pages: List[int],
-                  rs) -> None:
+                  rs, key=None, entry=None, replay=()) -> None:
         if req.rec is not None:
             # prefill done, slot owned: everything from here to retire
             # is the decode phase of the request timeline
             req.rec.mark("decode")
             req.rec.kv_pages = len(pages)
         self._state = self._program.insert(self._state, np.int32(j), rs)
-        self._slots[j] = _Slot(req, req.max_new_tokens, pages)
-        self._tok[j] = self._program.bos_id
-        self._prev[j] = self._program.bos_id
-        self._t[j] = 0
+        slot = _Slot(req, req.max_new_tokens, pages)
+        slot.key = key
+        slot.entry = entry
+        if self._prefix is not None:
+            # kept so the retiring sequence can be cached (the entry's
+            # prefill state); dropped at retire either way
+            slot.rs = rs
+        if replay:
+            # prefix-cache replay: the slot resumes AFTER the cached
+            # tokens — its next decode step continues at position
+            # len(replay) on top of the mapped pages
+            slot.tokens = [int(t) for t in replay]
+            slot.t = len(slot.tokens)
+            slot.replayed = slot.t
+        self._slots[j] = slot
+        self._tok[j] = (int(replay[-1]) if replay
+                        else self._program.bos_id)
+        self._prev[j] = (int(replay[-2]) if len(replay) >= 2
+                         else self._program.bos_id)
+        self._t[j] = slot.t
         if self._paged:
             self._pages[j, :] = self._sentinel
             self._pages[j, :len(pages)] = pages
 
+    # -- prefix-aware admission (ISSUE 15) ---------------------------------
+
+    def _try_prefix_admit(self, j: int, req: Request):
+        """Try to serve ``req`` from the radix cache. Returns one of
+
+        * ``("completed", None)`` — full hit: every token the request
+          could emit is cached; it was completed with ZERO device
+          dispatches and slot ``j`` stays free;
+        * ``("activated", None)`` — partial hit: cached tokens
+          replayed, shared pages mapped read-only (+ one COW copy at
+          the divergence boundary), slot ``j`` now decodes the
+          continuation;
+        * ``("deferred", None)`` — hit, but the continuation's fresh
+          pages are unavailable even after eviction (requeued);
+        * ``("miss", key)`` — no entry; the caller runs the normal
+          prefill and threads ``key`` through for retire-time insert.
+        """
+        prog = self._program
+        key = prog.prefix_key(req.feed)
+        tenant = getattr(req, "tenant", None)
+        entry = self._prefix.lookup(tenant, key)
+        if entry is None:
+            self._pfx_misses.inc()
+            return "miss", key
+        cap = req.max_new_tokens
+        toks = entry.tokens
+        n_replay = min(len(toks), cap)
+        eos = prog.eos_id
+        if eos in toks[:n_replay]:
+            n_replay = toks.index(eos) + 1
+        full = (n_replay == cap) or (toks[n_replay - 1] == eos)
+        skipped = (int(prog.prefill_tokens(req.feed))
+                   if hasattr(prog, "prefill_tokens") else 0)
+        if not full:
+            # continuation: map the cached FULL pages read-only, COW
+            # the boundary page, own fresh pages for the rest
+            p_need = prog.pages_needed(cap)
+            shared_full = n_replay // self._ps
+            partial = (n_replay % self._ps) != 0
+            # pin FIRST: the fresh-page grant below may evict LRU
+            # cache entries to make room, and the entry being mapped
+            # must never be its own eviction victim
+            self._prefix.pin(entry)
+            fresh = self._try_alloc(p_need - shared_full)
+            if fresh is None:
+                self._prefix.unpin(entry)
+                self._defer.inc()
+                if req.rec is not None:
+                    req.rec.mark("slot_wait")
+                self._queue.requeue_front(req)
+                return "deferred", None
+            shared = [int(p) for p in entry.pages[:shared_full]]
+            if shared:
+                self._alloc.share(shared)
+            if partial:
+                # copy-on-write: the first divergent write (position
+                # n_replay, next step) lands inside a cached page —
+                # device-copy it into a mapper-owned page FIRST, so
+                # the cached original is never written again
+                self._state = prog.copy_page(
+                    self._state, np.int32(fresh[0]),
+                    np.int32(entry.pages[shared_full]))
+                self._pfx_cow.inc()
+            self._pages_gauge.set(self._alloc.in_use)
+        self._pfx_hits.inc()
+        self._pfx_replayed.inc(n_replay)
+        self._pfx_skipped.inc(skipped)
+        rec = req.rec
+        if rec is not None:
+            # the explicit skipped-prefill attribution: the window a
+            # cold request would spend in `prefill` shows up as a
+            # (near-zero) `prefix_replay` phase plus the skipped-token
+            # counts on the record
+            rec.mark("prefix_replay")
+            rec.prefill_tokens_skipped = skipped
+            rec.prefix_hit_pages = (n_replay + self._ps - 1) // self._ps
+        if full:
+            self._pfx_full.inc()
+            now = time.perf_counter()
+            out = np.asarray(toks[:n_replay], np.int32)
+            req.t_first_token = now
+            self._ttft.record((now - req.t_enqueue) * 1e3)
+            if rec is not None:
+                rec.first_token(now)
+                rec.tokens = n_replay
+                rec.decode_steps = 0
+            req._complete(out)
+            self._completed.inc()
+            self._latency.record((now - req.t_enqueue) * 1e3)
+            trace.record_span(
+                "serve.request", req.t_enqueue, now, id=req.id,
+                tokens=n_replay, replica=self._replica_id,
+                rid=(rec.key if rec is not None else req.id),
+                hops=(len(rec.hops) if rec is not None else 1))
+            return "completed", None
+        with trace.span("serve.prefix_map", slot=j, id=req.id,
+                        replay=n_replay):
+            self._activate(j, req, shared + fresh, entry.request_state,
+                           key=key, entry=entry, replay=toks[:n_replay])
+        # the replayed tokens are client-visible NOW — TTFT is the
+        # map latency, not a prefill + first decode step
+        now = time.perf_counter()
+        req.t_first_token = now
+        self._ttft.record((now - req.t_enqueue) * 1e3)
+        if rec is not None:
+            rec.first_token(now)
+        return "activated", None
+
     def _refill(self) -> None:
         """Unchunked path: fill free slots from the queue, one whole
-        single-request prefill each, inserted without touching the
-        running slots."""
+        single-request prefill each (or a prefix-cache replay),
+        inserted without touching the running slots. A FULL cache hit
+        completes without consuming the slot — the loop keeps draining
+        the queue through it, so a burst of fully-cached requests is
+        answered in one pass instead of one per scheduler iteration."""
         for j in range(self._S):
             if self._slots[j] is not None:
                 continue
-            req = self._queue.pop(timeout=0.0)
-            if req is None:
-                return
-            if req.rec is not None:
-                req.rec.mark("prefill")
-            self._refilling = True
-            try:
-                pages = self._alloc_pages(req)
-                if pages is None:
-                    if req.rec is not None:
-                        # pool exhausted: the wait back at the queue
-                        # head is slot/page pressure, not queue depth
-                        req.rec.mark("slot_wait")
-                    self._queue.requeue_front(req)
+            while self._slots[j] is None:
+                req = self._queue.pop(timeout=0.0)
+                if req is None:
                     return
-                with trace.span("serve.prefill", slot=j, id=req.id):
-                    rs = self._program.prefill(self._params, req.feed)
-                    self._activate(j, req, pages, rs)
-            finally:
-                self._refilling = False
+                self._refilling = True
+                try:
+                    key = None
+                    if self._prefix is not None:
+                        outcome, key = self._try_prefix_admit(j, req)
+                        if outcome == "deferred":
+                            return
+                        if outcome == "completed":
+                            continue  # slot still free: keep draining
+                        if outcome == "activated":
+                            break
+                    if req.rec is not None:
+                        req.rec.mark("prefill")
+                    pages = self._alloc_pages(req)
+                    if pages is None:
+                        if req.rec is not None:
+                            # pool exhausted: the wait back at the
+                            # queue head is slot/page pressure, not
+                            # queue depth
+                            req.rec.mark("slot_wait")
+                        self._queue.requeue_front(req)
+                        return
+                    with trace.span("serve.prefill", slot=j, id=req.id):
+                        rs = self._program.prefill(self._params,
+                                                   req.feed)
+                        self._activate(j, req, pages, rs, key=key)
+                finally:
+                    self._refilling = False
 
     def _free_slot(self) -> Optional[int]:
         reserved = {pp.slot for pp in self._pending}
@@ -393,22 +627,36 @@ class ContinuousScheduler:
             j = self._free_slot()
             if j is None:
                 return
-            req = self._queue.pop(timeout=0.0)
-            if req is None:
-                return
-            if req.rec is not None:
-                req.rec.mark("prefill")
-            self._refilling = True
-            try:
-                pages = self._alloc_pages(req)
-                if pages is None:
-                    if req.rec is not None:
-                        req.rec.mark("slot_wait")
-                    self._queue.requeue_front(req)
+            while True:
+                req = self._queue.pop(timeout=0.0)
+                if req is None:
                     return
-                self._pending.append(_Prefill(req, j, pages))
-            finally:
-                self._refilling = False
+                self._refilling = True
+                try:
+                    key = None
+                    if self._prefix is not None:
+                        outcome, key = self._try_prefix_admit(j, req)
+                        if outcome == "completed":
+                            # full hit: the slot is still free — keep
+                            # draining fully-cached requests this pass
+                            continue
+                        if outcome != "miss":
+                            # activated (slot consumed, no chunks to
+                            # run) or deferred (requeued)
+                            return
+                    if req.rec is not None:
+                        req.rec.mark("prefill")
+                    pages = self._alloc_pages(req)
+                    if pages is None:
+                        if req.rec is not None:
+                            req.rec.mark("slot_wait")
+                        self._queue.requeue_front(req)
+                        return
+                    self._pending.append(_Prefill(req, j, pages,
+                                                  key=key))
+                    break
+                finally:
+                    self._refilling = False
         pp = self._pending[0]
         t_chunk = time.perf_counter()
         with trace.span("serve.prefill_chunk", slot=pp.slot,
@@ -422,20 +670,47 @@ class ContinuousScheduler:
         self._chunk_ctr.inc()
         if pp.k == self._chunks:
             self._pending.pop(0)
-            self._activate(pp.slot, pp.req, pp.pages, pp.carry)
+            self._activate(pp.slot, pp.req, pp.pages, pp.carry,
+                           key=pp.key)
 
     # -- retire / expire / fail --------------------------------------------
+
+    def _teardown_slot(self, slot: _Slot, cache: bool) -> None:
+        """Release one slot's page holdings. With ``cache`` (a clean
+        retire under the prefix cache) the refs of the WRITTEN pages
+        transfer to the radix index — the just-finished sequence
+        becomes the next identical request's replay — and only the
+        unwritten tail frees; otherwise (expiry, failure, cache off)
+        every ref this slot holds is dropped. Either way the mapped
+        entry's pin releases first, so LRU eviction sees the truth."""
+        if slot.entry is not None:
+            self._prefix.unpin(slot.entry)
+            slot.entry = None
+        if (cache and self._prefix is not None and slot.key is not None
+                and slot.t > 0 and slot.pages):
+            used = min(-(-int(slot.t) // self._ps), len(slot.pages))
+            self._prefix.insert(getattr(slot.req, "tenant", None),
+                                slot.key, slot.tokens,
+                                slot.pages[:used], slot.rs)
+            tail = slot.pages[used:]
+            if tail:
+                self._alloc.free(tail)
+            if self._paged:
+                self._pages_gauge.set(self._alloc.in_use)
+        else:
+            self._release_pages(slot.pages)
+        slot.rs = None
 
     def _retire(self, j: int, now: float) -> None:
         slot = self._slots[j]
         self._slots[j] = None
-        self._release_pages(slot.pages)
+        self._teardown_slot(slot, cache=True)
         self._clear_slot(j)
         req = slot.req
         rec = req.rec
         if rec is not None:
             rec.tokens = len(slot.tokens)
-            rec.decode_steps = int(slot.t)
+            rec.decode_steps = int(slot.t) - int(slot.replayed)
         req._complete(np.asarray(slot.tokens, np.int32))
         self._completed.inc()
         self._latency.record((now - req.t_enqueue) * 1e3)
@@ -456,7 +731,7 @@ class ContinuousScheduler:
                 continue
             if now > slot.req.deadline:
                 self._slots[j] = None
-                self._release_pages(slot.pages)
+                self._teardown_slot(slot, cache=False)
                 self._clear_slot(j)
                 self._timeouts.inc()
                 n_expired += 1
@@ -486,7 +761,7 @@ class ContinuousScheduler:
         for j, slot in enumerate(self._slots):
             if slot is not None:
                 self._slots[j] = None
-                self._release_pages(slot.pages)
+                self._teardown_slot(slot, cache=False)
                 self._clear_slot(j)
                 slot.req._fail(exc)
         for pp in self._pending:
@@ -692,6 +967,12 @@ class ContinuousScheduler:
                 "serve decode thread did not stop within the drain "
                 "window; in-flight requests may hang until their "
                 "result() timeout")
+        # the prefix cache intentionally holds pages while serving —
+        # at close it releases everything evictable so the leak checks
+        # ("0 pages in use after the last retire") stay meaningful
+        if self._prefix is not None:
+            self._prefix.clear()
+            self._pages_gauge.set(self._alloc.in_use)
         # unhook the gauges: their set_fns pin this scheduler (and the
         # device KV caches) inside a possibly long-lived shared
         # registry; after close they must read as plain None, not
@@ -699,6 +980,17 @@ class ContinuousScheduler:
         self.metrics.gauge("serve.tokens_per_sec").set_fn(None)
         if self._spec:
             self.metrics.gauge("serve.spec_accept_rate").set_fn(None)
+        if self._paged:
+            for name in ("serve.kv_page_refs", "serve.kv_shared_pages",
+                         "serve.kv_sharing_ratio"):
+                self.metrics.gauge(name).set_fn(None)
+        if self._prefix is not None:
+            for name in ("serve.prefix.hit_rate",
+                         "serve.prefix.evictions",
+                         "serve.prefix.cached_pages",
+                         "serve.prefix.entries",
+                         "serve.prefix.shared_pages"):
+                self.metrics.gauge(name).set_fn(None)
         self._state = None
 
 

@@ -125,15 +125,24 @@ class FleetRequest:
     on, in order — ``len(replicas) > 1`` means it failed over."""
 
     __slots__ = ("id", "feed", "deadline", "max_new_tokens",
+                 "tenant", "slo_class", "variant",
                  "t_enqueue", "t_done", "t_first_token", "replicas",
                  "rec", "_event", "_result", "_error", "_lock")
 
     def __init__(self, feed, deadline: Optional[float],
-                 max_new_tokens: Optional[int]):
+                 max_new_tokens: Optional[int],
+                 tenant=None, slo_class: Optional[str] = None,
+                 variant: Optional[str] = None):
         self.id = next(_freq_ids)
         self.feed = feed
         self.deadline = deadline
         self.max_new_tokens = max_new_tokens
+        # multi-tenant serving (ISSUE 15): the billing/namespace
+        # tenant, the SLO class, and the model variant this request
+        # must be served by (None = the base weights)
+        self.tenant = tenant
+        self.slo_class = slo_class
+        self.variant = variant
         self.t_enqueue = time.perf_counter()
         self.t_done: Optional[float] = None
         self.t_first_token: Optional[float] = None
@@ -248,6 +257,10 @@ class ServeFleet:
         # later scale-up swaps the newcomer onto the CURRENT weights
         # instead of whatever the factory closure captured
         self._pushed_params = None
+        # model-variant multiplexing (ISSUE 15): variant name -> params
+        # (same shapes as the base — swap_params' structural check is
+        # the guard). Empty = single-variant fleet, the pre-15 world.
+        self._variants: Dict[str, Any] = {}
         # at most one in-flight autoscaler action (its drain/compile
         # must not stack, and must not run on the maintenance thread)
         self._autoscale_busy = False
@@ -318,10 +331,22 @@ class ServeFleet:
         # interleaves with the slow factory build above can never
         # leave it serving the factory closure's stale weights
         with self._swap_lock:
-            if self._pushed_params is not None:
+            vname = None
+            if self._variants:
+                # multiplexed fleet: the newcomer serves the variant
+                # with the fewest live replicas (capacity rebalances
+                # toward starved variants on every scale-up)
+                counts = {v: 0 for v in self._variants}
+                for h in self._router.handles():
+                    if not h.dead and h.variant in counts:
+                        counts[h.variant] += 1
+                vname = min(sorted(counts), key=lambda k: counts[k])
+                session.swap_params(self._variants[vname])
+            elif self._pushed_params is not None:
                 session.swap_params(self._pushed_params)
             self._registries[rid] = registry
             handle = self._router.add(rid, session)
+            handle.variant = vname
         dt = time.perf_counter() - t0
         self.metrics.histogram("fleet.replica_spinup_seconds").record(dt)
         parallax_log.info("fleet: replica %d up in %.2fs", rid, dt)
@@ -403,16 +428,42 @@ class ServeFleet:
 
     def submit(self, feed: Dict[str, Any],
                deadline_ms: Optional[float] = None,
-               max_new_tokens: Optional[int] = None) -> FleetRequest:
+               max_new_tokens: Optional[int] = None,
+               tenant: Any = None,
+               slo_class: Optional[str] = None,
+               variant: Optional[str] = None) -> FleetRequest:
         """Admit one request to the fleet; returns its
         :class:`FleetRequest` future. Sheds with ``ServeOverloaded``
         only when EVERY placeable replica sheds; raises
-        ``ReplicaUnavailable`` when no replica is placeable at all."""
+        ``ReplicaUnavailable`` when no replica is placeable at all.
+
+        ``tenant`` / ``slo_class`` flow to the serving replica
+        (admission quota, prefix-cache namespace, queue priority);
+        ``variant`` constrains placement to replicas currently serving
+        that model variant (:meth:`assign_variants`) — failover hops
+        respect the same constraint, so a request never lands on the
+        wrong weights."""
         if self._closed:
             raise ServeClosed("fleet is closed")
+        if variant is not None and variant not in self._variants:
+            raise ValueError(
+                f"unknown model variant {variant!r}; assigned: "
+                f"{sorted(self._variants) or '(none)'}")
+        if variant is None and self._variants:
+            # symmetric with push_weights: on a multiplexed fleet an
+            # unconstrained placement would be served by WHICHEVER
+            # variant is least loaded — nondeterministic weights, not
+            # load balancing
+            raise ValueError(
+                f"this fleet multiplexes variants "
+                f"{sorted(self._variants)}; submit needs "
+                f"variant=<name> so the request is served by the "
+                f"weights it asked for")
         deadline = (time.perf_counter() + float(deadline_ms) / 1e3
                     if deadline_ms is not None else None)
-        freq = FleetRequest(feed, deadline, max_new_tokens)
+        freq = FleetRequest(feed, deadline, max_new_tokens,
+                            tenant=tenant, slo_class=slo_class,
+                            variant=variant)
         if obs_state.enabled:
             freq.rec = reqtrace.RequestRecord(
                 freq.id, t0=freq.t_enqueue, deadline=deadline,
@@ -499,6 +550,8 @@ class ServeFleet:
         admission-time refusals. Raises when no replica accepts."""
         exclude = tuple(exclude)
         any_shed = False
+        require = (None if freq.variant is None
+                   else (lambda h, v=freq.variant: h.variant == v))
         while True:
             remaining = self._remaining_ms(freq)
             if remaining is not None and remaining <= 0:
@@ -506,7 +559,7 @@ class ServeFleet:
                     f"fleet request {freq.id} deadline expired before "
                     f"placement")
             try:
-                handle = self._router.place(exclude)
+                handle = self._router.place(exclude, require=require)
             except ReplicaUnavailable:
                 if any_shed:
                     raise ServeOverloaded(
@@ -515,7 +568,8 @@ class ServeFleet:
             try:
                 sub = handle.session.submit(
                     freq.feed, deadline_ms=remaining,
-                    max_new_tokens=freq.max_new_tokens, rec=freq.rec)
+                    max_new_tokens=freq.max_new_tokens, rec=freq.rec,
+                    tenant=freq.tenant, slo_class=freq.slo_class)
             except ServeError as e:
                 exclude = exclude + (handle.rid,)
                 any_shed = any_shed or isinstance(e, ServeOverloaded)
@@ -588,7 +642,8 @@ class ServeFleet:
     # -- hot-swap (zero-downtime weight push) ------------------------------
 
     def push_weights(self, params,
-                     drain_timeout_s: Optional[float] = None) -> Dict:
+                     drain_timeout_s: Optional[float] = None,
+                     variant: Optional[str] = None) -> Dict:
         """Rotate every live replica through drain -> ``swap_params``
         -> re-admit, one at a time, so the rest of the fleet keeps
         serving throughout (zero downtime with >= 2 replicas; a
@@ -603,6 +658,12 @@ class ServeFleet:
         capacity) with a ``fleet_hotswap`` flight dump; the rotation
         continues, and the failure set is raised at the end.
 
+        On a variant-multiplexed fleet (:meth:`assign_variants`),
+        ``variant`` names WHICH variant these weights update and the
+        rotation touches only its replicas; pushing without a name is
+        refused there (silently overwriting every variant with one
+        checkpoint would be weight corruption, not an upgrade).
+
         Returns ``{rid: "swapped" | "skipped (<state>)"}``.
         """
         timeout = (drain_timeout_s if drain_timeout_s is not None
@@ -612,10 +673,26 @@ class ServeFleet:
         outcome: Dict[Any, str] = {}
         failures: Dict[Any, str] = {}
         with self._swap_lock:
-            # future scale-ups must come up on THESE weights, not on
-            # whatever the replica factory's closure captured
-            self._pushed_params = params
+            if self._variants and variant is None:
+                raise ValueError(
+                    f"this fleet multiplexes variants "
+                    f"{sorted(self._variants)}; push_weights needs "
+                    f"variant=<name> so only that variant's replicas "
+                    f"rotate")
+            if variant is not None:
+                if variant not in self._variants:
+                    raise ValueError(
+                        f"unknown model variant {variant!r}; "
+                        f"assigned: {sorted(self._variants) or '(none)'}")
+                self._variants[variant] = params
+            else:
+                # future scale-ups must come up on THESE weights, not
+                # on whatever the replica factory's closure captured
+                self._pushed_params = params
             for h in self._router.handles():
+                if variant is not None and h.variant != variant:
+                    outcome[h.rid] = "skipped (other variant)"
+                    continue
                 if h.dead or h.state == EJECTED:
                     outcome[h.rid] = f"skipped ({h.state})"
                     continue
@@ -652,6 +729,81 @@ class ServeFleet:
                 f"{failures} — they are ejected (stale weights must "
                 f"not rejoin); scale up to restore capacity")
         return outcome
+
+    def assign_variants(self, variants: Dict[str, Any],
+                        drain_timeout_s: Optional[float] = None) -> Dict:
+        """Multiplex N model VARIANTS on one fleet (ISSUE 15): each
+        live replica is rotated (drain -> ``swap_params`` -> re-admit,
+        the push_weights discipline) onto one variant's weights,
+        round-robin over the sorted variant names, and tagged so
+        ``submit(variant=...)`` routes only to matching replicas —
+        failover included. Same-shape weights ride the hot-swap
+        machinery, so the whole assignment costs zero recompiles.
+
+        With fewer live replicas than variants the excess variants are
+        unplaceable until a scale-up (which picks the starved variant
+        first) — reported loudly, not hidden. Returns
+        ``{rid: variant | "<failure>"}``.
+        """
+        if not variants:
+            raise ValueError("assign_variants needs >= 1 variant")
+        timeout = (drain_timeout_s if drain_timeout_s is not None
+                   else self._cfg.drain_timeout_s)
+        if self._anomaly is not None:
+            self._anomaly.notify_deliberate_change(
+                "fleet variant assignment")
+        names = sorted(variants)
+        outcome: Dict[Any, str] = {}
+        failures: Dict[Any, str] = {}
+        with self._swap_lock:
+            self._variants = dict(variants)
+            self._pushed_params = None
+            live = [h for h in self._router.handles()
+                    if not h.dead and h.state != EJECTED]
+            if len(live) < len(names):
+                parallax_log.warning(
+                    "fleet: %d variant(s) over %d live replica(s) — "
+                    "variant(s) %s have no replica until a scale-up",
+                    len(names), len(live),
+                    [v for i, v in enumerate(names) if i >= len(live)])
+            for i, h in enumerate(live):
+                vname = names[i % len(names)]
+                t0 = time.perf_counter()
+                self._router.set_draining(h.rid, True)
+                quiesced = self._wait_idle(h, timeout)
+                self._drain_s.record(time.perf_counter() - t0)
+                if not quiesced:
+                    msg = (f"replica {h.rid} did not quiesce within "
+                           f"{timeout}s")
+                    self._hotswap_fail(h, msg)
+                    outcome[h.rid] = failures[h.rid] = msg
+                    continue
+                try:
+                    with trace.span("fleet.assign_variant", rid=h.rid,
+                                    variant=vname):
+                        h.session.swap_params(variants[vname])
+                except Exception as e:
+                    msg = (f"variant swap failed on replica {h.rid}: "
+                           f"{type(e).__name__}: {e}")
+                    self._hotswap_fail(h, msg)
+                    outcome[h.rid] = failures[h.rid] = msg
+                    continue
+                h.variant = vname
+                self._router.set_draining(h.rid, False)
+                self._hotswaps.inc()
+                outcome[h.rid] = vname
+        self.metrics.gauge("fleet.variants").set(len(names))
+        self._update_gauges()
+        if failures:
+            raise RuntimeError(
+                f"variant assignment failed on {len(failures)} "
+                f"replica(s): {failures} — they are ejected; scale up "
+                f"to restore capacity")
+        return outcome
+
+    def variant_map(self) -> Dict[Any, Optional[str]]:
+        """``{rid: variant}`` for every routed replica (None = base)."""
+        return {h.rid: h.variant for h in self._router.handles()}
 
     def _hotswap_fail(self, handle: ReplicaHandle, msg: str) -> None:
         self._hotswap_failures.inc()

@@ -118,6 +118,11 @@ class ReplicaHandle:
         self.reopen_at: Optional[float] = None
         self.probation_left = 0            # successes still owed
         self.placing = 0                   # placements not yet enqueued
+        # model-variant multiplexing (ISSUE 15): which weight variant
+        # this replica currently serves (None = the fleet's base
+        # params). Placement with a ``require`` predicate filters on
+        # it; set by ServeFleet.assign_variants via swap_params.
+        self.variant: Optional[str] = None
         # (state, reason) before an administrative drain, restored by
         # set_draining(False) — rotation is not a health verdict either
         # way, so it must not launder DEGRADED/probation into HEALTHY
@@ -136,7 +141,7 @@ class ReplicaHandle:
         rate = self.error_rate()
         now = time.perf_counter() if now is None else now
         return {"state": self.state, "reason": self.state_reason,
-                "dead": self.dead,
+                "dead": self.dead, "variant": self.variant,
                 "error_rate": round(rate, 3) if rate is not None else None,
                 "latency_ewma_ms": (round(self.latency_ewma_ms, 3)
                                     if self.latency_ewma_ms is not None
@@ -201,13 +206,18 @@ class Router:
 
     # -- placement ---------------------------------------------------------
 
-    def place(self, exclude: Tuple = ()) -> ReplicaHandle:
+    def place(self, exclude: Tuple = (),
+              require: Optional[Callable[[ReplicaHandle], bool]] = None
+              ) -> ReplicaHandle:
         """Pick the least-loaded trusted replica (healthy first,
         degraded with a large penalty). Every ``probe_every``-th
         placement instead routes to a PROBATIONER (a circuit-reopened
         replica still owing successes) when one exists — the half-open
         trickle that lets it demonstrate recovery; the penalty alone
         would starve it whenever any healthy replica has headroom.
+        ``require`` further constrains the candidate set (the fleet's
+        model-variant routing: only replicas serving the requested
+        variant are eligible — probes included).
         Increments the handle's ``placing`` count — the caller MUST
         pair it with ``done_placing`` after the submit lands, so a
         drain can tell "idle" from "a placement is racing me". Raises
@@ -220,7 +230,8 @@ class Router:
                           if h.rid not in exclude
                           and h.state == DEGRADED
                           and h.probation_left > 0
-                          and h.session.alive]
+                          and h.session.alive
+                          and (require is None or require(h))]
                 if probes:
                     probe = min(probes, key=lambda h:
                                 h.session.load() + h.placing)
@@ -237,6 +248,8 @@ class Router:
                     continue
                 if not h.session.alive:
                     continue
+                if require is not None and not require(h):
+                    continue
                 score = h.session.load() + h.placing
                 if h.state == DEGRADED:
                     score += self.policy.degraded_penalty
@@ -246,7 +259,9 @@ class Router:
                 raise ReplicaUnavailable(
                     f"no serving replica available (states: "
                     f"{ {h.rid: h.state for h in self._handles.values()} }"
-                    f", excluded: {list(exclude)})")
+                    f", excluded: {list(exclude)}"
+                    + (", with a placement constraint"
+                       if require is not None else "") + ")")
             best.placing += 1
             return best
 

@@ -130,8 +130,12 @@ class ServeSession:
         # request keeps ONE decomposition across hops (and lands in
         # the fleet's ring, not this one).
         self.reqtrace = reqtrace.RequestTraceRing(self.metrics)
-        self._queue = RequestQueue(sc.max_queue, self.metrics,
-                                   on_timeout=self._on_deadline_breach)
+        self._queue = RequestQueue(
+            sc.max_queue, self.metrics,
+            on_timeout=self._on_deadline_breach,
+            tenant_quotas=getattr(sc, "tenant_quotas", None),
+            default_tenant_quota=getattr(sc, "default_tenant_quota",
+                                         None))
         self._closed = False
         self._close_lock = threading.Lock()
 
@@ -310,14 +314,26 @@ class ServeSession:
     def submit(self, feed: Dict[str, Any],
                deadline_ms: Optional[float] = None,
                max_new_tokens: Optional[int] = None,
-               rec: Optional[reqtrace.RequestRecord] = None) -> Request:
+               rec: Optional[reqtrace.RequestRecord] = None,
+               tenant: Any = None,
+               slo_class: Optional[str] = None) -> Request:
         """Admit one request; returns its :class:`Request` future.
 
         Raises :class:`ServeOverloaded` when admission control sheds it
-        (queue full) and :class:`ServeClosed` after ``close()``. The
-        deadline (``deadline_ms`` or ``ServeConfig.default_deadline_ms``)
+        (queue full), :class:`TenantQuotaExceeded` when ``tenant`` is
+        at its admission quota, and :class:`ServeClosed` after
+        ``close()``. The deadline (``deadline_ms``, else the
+        ``slo_class`` deadline, else ``ServeConfig.default_deadline_ms``)
         bounds QUEUE+SERVE time: an expired request is dropped with
         :class:`DeadlineExceeded` instead of served late.
+
+        ``tenant`` namespaces the prefix cache (a tenant's cached
+        prefixes are invisible to every other tenant) and bills the
+        request against the tenant's admission quota; ``slo_class``
+        must be a declared ``ServeConfig.slo_classes`` name and sets
+        this request's default deadline, plus — in continuous-decode
+        mode — its queue priority (one-shot batch formation stays
+        FIFO/group-keyed; only the class deadline applies there).
 
         ``rec`` is the fleet's lifecycle record when this submit is a
         failover hop (the record accumulates across hops); standalone
@@ -329,15 +345,21 @@ class ServeSession:
             # chaos hook: an armed `saturate` fault sheds here, exactly
             # like a full queue would (ServeOverloaded, retryable)
             self._faults.on_admission(self.replica_id)
+        slo_rank, slo_ddl_ms = sc.resolve_slo_class(slo_class)
         ddl_ms = (deadline_ms if deadline_ms is not None
+                  else slo_ddl_ms if slo_ddl_ms is not None
                   else sc.default_deadline_ms)
         deadline = (time.perf_counter() + float(ddl_ms) / 1e3
                     if ddl_ms is not None else None)
         if self._scheduler is not None:
             req = self._scheduler.make_request(feed, deadline,
-                                               max_new_tokens)
+                                               max_new_tokens,
+                                               tenant=tenant,
+                                               slo_rank=slo_rank)
         else:
-            req = self._make_one_shot_request(feed, deadline)
+            req = self._make_one_shot_request(feed, deadline,
+                                              tenant=tenant,
+                                              slo_rank=slo_rank)
         if rec is None and obs_state.enabled:
             rec = reqtrace.RequestRecord(req.id, t0=t_sub,
                                          deadline=deadline,
@@ -369,7 +391,16 @@ class ServeSession:
         (tools/serve_report.py reads these)."""
         return self.reqtrace.records(last)
 
-    def _make_one_shot_request(self, feed, deadline) -> Request:
+    def prefix_stats(self) -> Optional[Dict[str, Any]]:
+        """The prefix cache's own snapshot (entries, cached pages,
+        pinned entries, insertions/evictions); None in one-shot mode
+        or with ``ServeConfig.prefix_cache`` off."""
+        if self._scheduler is None:
+            return None
+        return self._scheduler.prefix_stats()
+
+    def _make_one_shot_request(self, feed, deadline, tenant=None,
+                               slo_rank: int = 0) -> Request:
         feed = {k: np.asarray(v) for k, v in feed.items()}
         if set(feed) != set(self._example):
             raise ValueError(
@@ -395,7 +426,8 @@ class ServeSession:
                 f"{sorted([(n, s) for n, s, _ in sig] for sig in self._admitted)}; "
                 f"serving it would compile at serve time — fix the "
                 f"feed shapes or declare matching length_buckets")
-        return Request(feed, deadline=deadline, group_key=group_key)
+        return Request(feed, deadline=deadline, group_key=group_key,
+                       tenant=tenant, slo_rank=slo_rank)
 
     def _on_deadline_breach(self, n: int = 1,
                             where: str = "queue") -> None:

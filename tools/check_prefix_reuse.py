@@ -1,0 +1,308 @@
+"""Prefix-reuse guard: warm TTFT beats cold, tokens exactly equal,
+zero recompiles, zero leaks, zero cross-tenant visibility.
+
+ISSUE 15 acceptance, enforced in tier-1
+(tests/test_prefix_cache.py::test_prefix_reuse_guard) and runnable
+directly::
+
+    JAX_PLATFORMS=cpu python tools/check_prefix_reuse.py
+
+Four contracts over a tiny-NMT continuous-decode rig at >= 50%
+shared-prefix load (tools/loadgen.py ``shared_prefix_feed`` — the
+shared/unique split is a pure function of the request index, so every
+phase replays the EXACT same request stream):
+
+* **exact reuse** — every token stream served through the prefix cache
+  (cold round, warm round, extended-cap round) is BIT-identical to the
+  sharing-disabled session fed the same requests: reuse is a latency
+  optimization, never a result change.
+* **warm TTFT** — the same request stream re-submitted against the
+  populated cache has a p50 TTFT measurably below the sharing-disabled
+  A/B on the same rig (full hits complete with zero device dispatches;
+  the guard requires warm <= 0.8x cold, the measured gap is far
+  larger).
+* **zero serve-time compiles / zero leaked pages** — the prefix paths
+  (replay activation, COW page copy, eviction) stay inside the closed
+  AOT signature set (``jax.monitoring`` backend-compile witness at 0)
+  and after close every pool page is back (the cache's held pages are
+  released at drain; ref-count accounting means a page leak cannot
+  hide behind sharing).
+* **tenant isolation under churn** — tenant B submitting tenant A's
+  EXACT sources gets zero prefix hits (the per-tenant radix roots make
+  cross-tenant mapping structurally impossible; the hit counter is the
+  witness that no foreign page was ever mapped) while its OUTPUTS
+  still equal A's (greedy determinism — proving the isolation is not
+  hiding a result difference), and an eviction + COW churn phase on a
+  starved pool (evictions > 0, COW copies > 0, deferrals allowed)
+  keeps every invariant above.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_compile_events = {"n": 0, "active": False}
+
+
+def _install_listener():
+    import jax
+
+    def _listen(event, duration, **kw):
+        if _compile_events["active"] and "backend_compile" in event:
+            _compile_events["n"] += 1
+
+    jax.monitoring.register_event_duration_secs_listener(_listen)
+
+
+def _pct(vals, q):
+    from parallax_tpu.obs.metrics import nearest_rank
+    v = nearest_rank(sorted(vals), q)
+    return round(v, 3) if v is not None else None
+
+
+def _serve_round(sess, feeds, caps, submit_kw=None,
+                 timeout_s: float = 300.0):
+    """Submit every (feed, cap) and gather ``(tokens, ttft_ms)`` in
+    order — outputs kept so rounds can be diffed token-for-token."""
+    reqs = [sess.submit(f, max_new_tokens=c, **(submit_kw or {}))
+            for f, c in zip(feeds, caps)]
+    outs, ttfts = [], []
+    for r in reqs:
+        outs.append([int(t) for t in r.result(timeout=timeout_s)])
+        t_first = r.t_first_token if r.t_first_token is not None \
+            else r.t_done
+        ttfts.append((t_first - r.t_enqueue) * 1e3)
+    return outs, ttfts
+
+
+def _decode_rig(prefix_cache: bool, slots: int = 8,
+                pool_pages: int = 72, **kw):
+    # pool = 3x the slots' max working set (8 slots x 3 pages): the
+    # cache needs headroom BEYOND in-flight pages to hold prefixes
+    # between requests — a pool sized exactly to the working set
+    # degenerates into evict-on-every-retire
+    from tools import loadgen
+    return loadgen.demo_decode_session(
+        slots=slots, T=12, Ts=8, page_size=4, pool_pages=pool_pages,
+        model_dim=32, num_layers=2, vocab=64,
+        prefill_chunk_layers=None, spec_tokens=0, speculative=False,
+        prefix_cache=prefix_cache, **kw)
+
+
+def measure(n_requests: int = 36, prefix_share: float = 0.6) -> dict:
+    import numpy as np  # noqa: F401  (loadgen feeds are numpy)
+
+    from tools import loadgen
+
+    _install_listener()
+    make_feed = loadgen.shared_prefix_feed(
+        Ts=8, vocab=64, prefix_share=prefix_share, pool_size=3)
+    feeds = [make_feed(i) for i in range(n_requests)]
+    # mixed caps: odd requests stop mid-page so the warm round's
+    # longer caps exercise the COW boundary, not just full replays
+    caps = [7 if i % 2 else 12 for i in range(n_requests)]
+
+    # -- baseline: sharing DISABLED, same stream -----------------------
+    base_sess, _ = _decode_rig(prefix_cache=False)
+    try:
+        _compile_events["n"] = 0
+        _compile_events["active"] = True
+        t0 = time.perf_counter()
+        base_outs, base_ttfts = _serve_round(base_sess, feeds, caps)
+        base_wall = time.perf_counter() - t0
+        _compile_events["active"] = False
+        base_stats = base_sess.stats()
+        base_alloc = base_sess._scheduler._alloc
+    finally:
+        base_sess.close()
+
+    # -- prefix cache ON: cold round, warm round, extended caps --------
+    sess, _ = _decode_rig(prefix_cache=True)
+    try:
+        _compile_events["active"] = True
+        t0 = time.perf_counter()
+        cold_outs, cold_ttfts = _serve_round(sess, feeds, caps)
+        cold_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        warm_outs, warm_ttfts = _serve_round(sess, feeds, caps)
+        warm_wall = time.perf_counter() - t0
+        # extended caps: every capped-at-7 request re-runs at 12 — a
+        # partial hit that must COW the boundary page and CONTINUE
+        ext_caps = [12] * n_requests
+        ext_outs, _ = _serve_round(sess, feeds, ext_caps)
+        _compile_events["active"] = False
+        stats = sess.stats()
+        pstats = sess.prefix_stats()
+        alloc = sess._scheduler._alloc
+    finally:
+        sess.close()
+    # baseline for the extended round, from the sharing-off rig
+    base2_sess, _ = _decode_rig(prefix_cache=False)
+    try:
+        ext_base_outs, _ = _serve_round(base2_sess, feeds, ext_caps)
+    finally:
+        base2_sess.close()
+
+    tok_mismatches = sum(
+        1 for a, b in zip(base_outs, cold_outs) if a != b) + sum(
+        1 for a, b in zip(base_outs, warm_outs) if a != b) + sum(
+        1 for a, b in zip(ext_base_outs, ext_outs) if a != b)
+
+    # -- tenant isolation + eviction/COW churn on a starved pool -------
+    tsess, _ = _decode_rig(prefix_cache=True, slots=4, pool_pages=9)
+    iso = {}
+    try:
+        _compile_events["active"] = True
+        pool_feeds = [make_feed(i) for i in (1, 3, 5)]  # shared pool
+        a_caps = [7, 12, 7]
+        a_outs, _ = _serve_round(tsess, pool_feeds, a_caps,
+                                 submit_kw={"tenant": "tenant-a"})
+        hits_after_a = tsess.stats()["serve.prefix.hits"]
+        b_outs, _ = _serve_round(tsess, pool_feeds, a_caps,
+                                 submit_kw={"tenant": "tenant-b"})
+        st = tsess.stats()
+        hits_after_b = st["serve.prefix.hits"]
+        # churn: 8 distinct max-cap sequences through a 9-page pool —
+        # cache pressure MUST evict (LRU, unpinned only) and the
+        # re-submitted pool sources exercise COW on partial replays
+        churn_feeds = [make_feed(100 + i) for i in range(8)] \
+            + pool_feeds
+        churn_caps = [12] * 8 + [12, 12, 12]
+        c_outs, _ = _serve_round(
+            tsess, churn_feeds, churn_caps,
+            submit_kw={"tenant": "tenant-a"})
+        a2_outs, _ = _serve_round(tsess, pool_feeds, a_caps,
+                                  submit_kw={"tenant": "tenant-a"})
+        _compile_events["active"] = False
+        tstats = tsess.stats()
+        tp = tsess.prefix_stats()
+        talloc = tsess._scheduler._alloc
+        iso = {
+            "a_hits": hits_after_a,
+            "b_hits_delta": hits_after_b - hits_after_a,
+            "b_outputs_equal_a": [list(x) for x in b_outs]
+            == [list(x) for x in a_outs],
+            "a_replay_outputs_equal": a2_outs == a_outs,
+            "evictions": tstats.get("serve.prefix.evictions"),
+            "cow_copies": tstats.get("serve.prefix.cow_copies"),
+            "deferred": tstats.get("serve.kv_refill_deferred", 0),
+            "cache": tp,
+        }
+    finally:
+        tsess.close()
+
+    return {
+        "requests_per_round": n_requests,
+        "prefix_share": prefix_share,
+        "ttft_ms_p50_cold_nosharing": _pct(base_ttfts, 0.5),
+        "ttft_ms_p50_cold": _pct(cold_ttfts, 0.5),
+        "ttft_ms_p50_warm": _pct(warm_ttfts, 0.5),
+        "ttft_ms_p95_warm": _pct(warm_ttfts, 0.95),
+        "wall_s": {"nosharing": round(base_wall, 3),
+                   "cold": round(cold_wall, 3),
+                   "warm": round(warm_wall, 3)},
+        "tokens_per_sec_warm": round(
+            sum(len(o) for o in warm_outs) / warm_wall, 2)
+        if warm_wall > 0 else None,
+        "tokens_per_sec_nosharing": round(
+            sum(len(o) for o in base_outs) / base_wall, 2)
+        if base_wall > 0 else None,
+        "token_mismatches": tok_mismatches,
+        "hit_rate": stats.get("serve.prefix.hit_rate"),
+        "hits": stats.get("serve.prefix.hits"),
+        "misses": stats.get("serve.prefix.misses"),
+        "full_hits": stats.get("serve.prefix.full_hits"),
+        "cow_copies": stats.get("serve.prefix.cow_copies"),
+        "replayed_tokens": stats.get("serve.prefix.replayed_tokens"),
+        "prefill_tokens_skipped": stats.get(
+            "serve.prefix.prefill_tokens_skipped"),
+        "evictions": stats.get("serve.prefix.evictions"),
+        "kv_sharing_ratio_seen": stats.get("serve.kv_sharing_ratio"),
+        "prefix_cache": pstats,
+        "recompiles": (stats.get("serve.recompiles", 0)
+                       + base_stats.get("serve.recompiles", 0)),
+        "serve_time_xla_compiles": _compile_events["n"],
+        # post-close page accounting: the allocator itself, AFTER the
+        # drain released the cache — a leak cannot hide behind sharing
+        # because in_use counts physical pages once
+        "pages_in_use_after_close": {
+            "nosharing": base_alloc.in_use,
+            "prefix": alloc.in_use,
+            "tenant_rig": talloc.in_use,
+        },
+        "tenant_isolation": iso,
+    }
+
+
+def check(result: dict) -> list:
+    """-> list of violated invariants (empty = pass)."""
+    bad = []
+    if result["token_mismatches"] != 0:
+        bad.append(f"{result['token_mismatches']} request(s) decoded "
+                   f"DIFFERENT tokens with the prefix cache on — "
+                   f"exact-reuse broken")
+    cold = result["ttft_ms_p50_cold_nosharing"]
+    warm = result["ttft_ms_p50_warm"]
+    if cold is None or warm is None:
+        bad.append("missing TTFT percentiles")
+    elif warm > 0.8 * cold:
+        bad.append(f"warm TTFT p50 {warm}ms not measurably below the "
+                   f"no-sharing cold p50 {cold}ms (need <= 0.8x)")
+    if result["serve_time_xla_compiles"] != 0:
+        bad.append(f"{result['serve_time_xla_compiles']} XLA "
+                   f"compile(s) fired during prefix-cached serving — "
+                   f"the replay/COW/eviction paths leaked a signature")
+    if result["recompiles"] != 0:
+        bad.append(f"serve.recompiles = {result['recompiles']}")
+    if (result["hit_rate"] or 0) < 0.4:
+        bad.append(f"prefix hit rate {result['hit_rate']} < 0.4 at "
+                   f"{result['prefix_share']} shared-prefix load")
+    if not result["full_hits"]:
+        bad.append("no full hit — the warm round never replayed a "
+                   "complete cached sequence")
+    if not result["cow_copies"]:
+        bad.append("no COW copy — the extended-cap round never hit "
+                   "the divergence boundary")
+    for name, n in result["pages_in_use_after_close"].items():
+        if n != 0:
+            bad.append(f"{n} page(s) leaked after close ({name} rig)")
+    iso = result["tenant_isolation"]
+    if iso.get("b_hits_delta", 1) != 0:
+        bad.append(f"tenant B saw {iso.get('b_hits_delta')} prefix "
+                   f"hit(s) on tenant A's sources — cross-tenant "
+                   f"visibility")
+    if not iso.get("b_outputs_equal_a"):
+        bad.append("tenant B's outputs differ from tenant A's for "
+                   "identical requests (isolation is masking a "
+                   "result bug)")
+    if not iso.get("a_replay_outputs_equal"):
+        bad.append("tenant A's post-churn replay changed its tokens")
+    if not iso.get("evictions"):
+        bad.append("the starved-pool churn phase evicted nothing — "
+                   "the rig no longer exercises LRU eviction")
+    return bad
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=36)
+    ap.add_argument("--prefix-share", type=float, default=0.6)
+    args = ap.parse_args(argv)
+    result = measure(n_requests=args.requests,
+                     prefix_share=args.prefix_share)
+    violations = check(result)
+    result["violations"] = violations
+    result["ok"] = not violations
+    print(json.dumps(result, indent=2, default=str))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

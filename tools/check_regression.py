@@ -203,6 +203,15 @@ SECONDARY_GATES = (
     # either-side keys skip, per the established convention)
     ("serve.continuous.report.buckets.p99.ttft_ms", False),
     ("serve.continuous.report.buckets.p99.total_ms", False),
+    # prefix-aware KV reuse (ISSUE 15, bench "serve.prefix" block from
+    # tools/check_prefix_reuse.py): the warm-path TTFT is THE number
+    # prefix reuse exists to buy — a rise means replay/COW/eviction
+    # overhead crept in — and the hit rate at the fixed 50%-shared
+    # load dropping means the radix index stopped matching what it
+    # used to (keying or eviction drift, not workload drift: the
+    # request stream is deterministic)
+    ("serve.prefix.ttft_ms_p50_warm", False),
+    ("serve.prefix.hit_rate", True),
     # fleet robustness latencies (ISSUE 7, tools/check_fleet_faults):
     # how long a crash's failed-over requests take to land on healthy
     # replicas, and the longest fleet-wide completion gap during a

@@ -45,13 +45,17 @@ def _pct(sorted_ms, q):
 
 def run_load(session, make_feed, n_requests: int, concurrency: int = 4,
              deadline_ms=None, max_new_tokens=None,
-             result_timeout_s: float = 120.0) -> dict:
+             result_timeout_s: float = 120.0,
+             submit_kw=None) -> dict:
     """Submit ``n_requests`` through ``concurrency`` closed-loop client
     threads; ``make_feed(i)`` builds request ``i``'s feed. Returns the
     outcome/latency report (shed and timed-out requests are counted,
-    not errors)."""
+    not errors). ``submit_kw`` (e.g. ``{"tenant": "a"}``) is forwarded
+    to every ``session.submit``."""
     from parallax_tpu.serve import (DeadlineExceeded, ServeClosed,
                                     ServeOverloaded)
+
+    submit_kw = submit_kw or {}
 
     lock = threading.Lock()
     counter = {"next": 0}
@@ -71,7 +75,8 @@ def run_load(session, make_feed, n_requests: int, concurrency: int = 4,
             try:
                 req = session.submit(make_feed(i),
                                      deadline_ms=deadline_ms,
-                                     max_new_tokens=max_new_tokens)
+                                     max_new_tokens=max_new_tokens,
+                                     **submit_kw)
             except ServeOverloaded:
                 with lock:
                     outcomes["shed"] += 1
@@ -178,19 +183,53 @@ def demo_session(max_batch: int = 8, length_buckets=(16, 32),
     return sess, make_feed
 
 
+def shared_prefix_feed(Ts: int = 8, vocab: int = 256,
+                       prefix_share: float = 0.5, pool_size: int = 4,
+                       pool_seed: int = 777):
+    """A ``make_feed(i)`` with a DETERMINISTIC shared-prefix pool
+    (ISSUE 15): a ``prefix_share`` fraction of requests draw their
+    source from ``pool_size`` fixed sequences (the system-prompt /
+    template / retry population) and the rest are unique. Which
+    requests are shared — and which pool member they draw — is a pure
+    function of ``i``, so an A/B rig (sharing on vs off) and a
+    bit-identity sweep replay the EXACT same request stream."""
+    import numpy as np
+
+    if not 0.0 <= float(prefix_share) <= 1.0:
+        raise ValueError(
+            f"prefix_share must be in [0, 1], got {prefix_share}")
+    pr = np.random.default_rng(pool_seed)
+    pool = [pr.integers(3, vocab, (Ts,)).astype(np.int32)
+            for _ in range(max(1, int(pool_size)))]
+
+    def make_feed(i):
+        r = np.random.default_rng(3000 + i)
+        if r.random() < prefix_share:
+            return {"src": pool[int(r.integers(0, len(pool)))]}
+        L = int(r.integers(max(2, Ts // 2), Ts + 1))
+        return {"src": r.integers(3, vocab, (L,)).astype(np.int32)}
+
+    return make_feed
+
+
 def demo_decode_session(slots: int = 16, T: int = 16, Ts: int = 8,
                         page_size: int = 4, pool_pages=None,
                         prefill_chunk_layers=1, spec_tokens: int = 2,
                         model_dim: int = 64, num_layers: int = 2,
                         vocab: int = 256, max_queue: int = 4096,
                         paged: bool = True, speculative: bool = True,
+                        prefix_cache: bool = False,
+                        prefix_cache_max_pages=None,
+                        tenant_quotas=None, slo_classes=None,
                         metrics=None):
     """A tiny-NMT continuous-decode session with the full ISSUE 6
     stack on by default — paged KV pool, chunked prefill, layer-skip
-    speculative draft. Returns ``(session, make_feed)``; ``make_feed``
-    produces mixed-length sources. ``paged=False`` / ``speculative=
-    False`` select the dense / plain ablations (the A/B rigs of
-    tools/nmt_decode_timing.py and the sweep)."""
+    speculative draft — plus the ISSUE 15 knobs (prefix cache, tenant
+    quotas, SLO classes) off by default. Returns ``(session,
+    make_feed)``; ``make_feed`` produces mixed-length sources.
+    ``paged=False`` / ``speculative=False`` select the dense / plain
+    ablations (the A/B rigs of tools/nmt_decode_timing.py and the
+    sweep)."""
     import jax
     import numpy as np
 
@@ -217,7 +256,10 @@ def demo_decode_session(slots: int = 16, T: int = 16, Ts: int = 8,
                   draft_params=dparams)
     prog = NMTDecodeProgram(cfg, max_src_len=Ts, max_len=T, **kw)
     pcfg = parallax.Config(serve_config=parallax.ServeConfig(
-        max_batch=slots, max_queue=max_queue))
+        max_batch=slots, max_queue=max_queue,
+        prefix_cache=prefix_cache,
+        prefix_cache_max_pages=prefix_cache_max_pages,
+        tenant_quotas=tenant_quotas, slo_classes=slo_classes))
     sess = parallax.ServeSession(program=prog, params=params,
                                  config=pcfg, metrics=metrics)
 
@@ -374,15 +416,34 @@ def main(argv=None) -> int:
     ap.add_argument("--sweep", type=str, default=None,
                     help="comma-separated offered-concurrency levels; "
                          "decode mode only (e.g. 8,16,32,64)")
+    ap.add_argument("--prefix-share", type=float, default=None,
+                    help="decode mode: fraction of requests drawing "
+                         "their source from a deterministic shared "
+                         "pool (e.g. 0.5); enables the prefix cache")
+    ap.add_argument("--prefix-pool", type=int, default=4,
+                    help="size of the shared-prefix pool")
     args = ap.parse_args(argv)
     if args.sweep:
+        if args.prefix_share is not None:
+            ap.error("--prefix-share is not wired into --sweep; the "
+                     "sweep prices raw concurrency (run --mode decode "
+                     "--prefix-share for the shared-prefix rig, or "
+                     "tools/check_prefix_reuse.py for the full A/B)")
         levels = tuple(int(x) for x in args.sweep.split(","))
         rows = sweep_decode(levels=levels)
         print(json.dumps({"sweep": rows}, indent=2, default=str))
         return 0 if all(r["failed"] == 0 for r in rows) else 1
     if args.mode == "decode":
-        sess, make_feed = demo_decode_session()
+        sess, make_feed = demo_decode_session(
+            prefix_cache=args.prefix_share is not None)
+        if args.prefix_share is not None:
+            make_feed = shared_prefix_feed(
+                prefix_share=args.prefix_share,
+                pool_size=args.prefix_pool)
     else:
+        if args.prefix_share is not None:
+            ap.error("--prefix-share needs --mode decode (the prefix "
+                     "cache lives on the continuous-decode path)")
         sess, make_feed = demo_session()
     try:
         report = run_load(sess, make_feed, args.requests,
