@@ -70,6 +70,13 @@ PROBE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #               step, the interpret-tax witness, and the analytic
 #               fwd+bwd HBM-bytes story at the flagship shape) — a
 #               new block with gate-side skip semantics, no bump.
+#               r16+: a top-level "attn" block (ISSUE 16,
+#               tools/bench_paged_attn.py: fused paged-attention
+#               kernel vs full-width einsum gather across pool
+#               occupancies, the interpret-tax witness, and the
+#               analytic live-pages-only vs gather HBM table at the
+#               flagship decode shape) — a new block with gate-side
+#               skip semantics, no bump.
 BENCH_VERSION = 3
 BASELINE_BASIS = ("sampled-softmax vs full-softmax LM1B at the same "
                   "memory-limited batch; headline measured separately at "
@@ -640,6 +647,26 @@ def worker_main():
             print(f"# lstm bench failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
 
+    # Paged-attention block (ISSUE 16): one paged decode-step
+    # attention A/B — fused Pallas kernel (live pages only) vs the
+    # full-width einsum gather — across pool occupancies, plus the
+    # analytic allocated-pages-only vs full-width HBM table at the
+    # flagship decode shape. Off-TPU the kernel runs interpreted, so
+    # the measured ratios carry the interpret-tax witness (the
+    # equal-bytes 100%-occupancy ratio) and the CPU-relative caveat
+    # in-artifact; tools/check_regression.py secondary-gates
+    # attn.step_ms.kernel and (drift) attn.kernel_over_einsum.
+    # PARALLAX_BENCH_ATTN=0 skips.
+    attn_snap = None
+    if os.environ.get("PARALLAX_BENCH_ATTN", "1") != "0":
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from tools import bench_paged_attn
+            attn_snap = bench_paged_attn.measure()
+        except Exception as e:
+            print(f"# attn bench failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
     # Auto-tuner block (ISSUE 10): one MeshSearch decision end to end
     # on the smoke-scale flagship — candidates enumerated / pruned /
     # trialed, predicted-vs-measured ms for the measured winner,
@@ -826,6 +853,12 @@ def worker_main():
         # fwd+bwd step_ms (CPU-relative off-TPU, interpret-tax witness
         # stamped) + the analytic flagship HBM-bytes story
         "lstm": lstm_snap,
+        # paged-attention decode A/B (ISSUE 16): fused Pallas kernel
+        # vs full-width einsum gather across pool occupancies
+        # (CPU-relative off-TPU, interpret-tax witness stamped) + the
+        # analytic live-pages-only vs gather HBM table at the
+        # flagship decode shape
+        "attn": attn_snap,
         # checkpoint/recovery costs (ISSUE 9): save/restore latency,
         # bytes, async-vs-sync step-overhead A/B, chaos-harness outcome
         "ckpt": ckpt_snap,

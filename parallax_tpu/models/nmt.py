@@ -376,7 +376,7 @@ def _init_paged_self_cache(cfg, pool_pages: int, page_size: int):
 
 
 def _decode_tokens_cached(cfg, params, tok, t, kc, vc, ck, cv, src_valid,
-                          pages=None, page_size=None):
+                          pages=None, page_size=None, attn_impl=None):
     """``G`` cached decoder steps in ONE dispatch: ``tok`` [S, G] holds
     each slot's tokens for positions ``t[s] .. t[s]+G-1``; returns
     (logits [S, G, V], kc, vc). With ``G == 1`` this is the
@@ -391,6 +391,18 @@ def _decode_tokens_cached(cfg, params, tok, t, kc, vc, ck, cv, src_valid,
     through the page table; ``pages=None`` keeps the dense
     [L, S, T, D] per-slot layout.
 
+    ``attn_impl`` picks the paged self-attention executor
+    ('auto' | 'kernel' | 'einsum', None = 'auto'; the
+    PARALLAX_PAGED_ATTN env var overrides): 'kernel' streams only
+    live pages through the fused Pallas decode kernel
+    (ops/pallas_paged_attention — sentinel pages masked in-kernel, no
+    full-width gather), 'einsum' keeps the clip-then-mask gather
+    below, 'auto' resolves per backend/VMEM fit. Both executors
+    produce identical greedy TOKENS; the kernel's online softmax is
+    not bitwise-equal to the full softmax, so its exact-greedy
+    guarantee is at token level (tested in tests/test_paged_attn.py).
+    Ignored for the dense layout and for cross-attention.
+
     Bit-identity note: the K/V/MLP/output projections are batched over
     ``G`` (row-wise bit-identical to the G=1 shapes on this backend)
     but the two attention einsums are UNROLLED over the G queries at
@@ -404,10 +416,15 @@ def _decode_tokens_cached(cfg, params, tok, t, kc, vc, ck, cv, src_valid,
     S, G = tok.shape
     paged = pages is not None
     if paged:
+        # lazy: ops -> models would be circular the other way round
+        from parallax_tpu.ops import pallas_paged_attention as _ppa
         pool, ps = kc.shape[1], int(page_size)
         P = pages.shape[1]
         Tbuf = P * ps
-        safe_pages = jnp.clip(pages, 0, pool - 1)
+        impl = _ppa.resolve_impl(
+            attn_impl, G=G, D=D, page_size=ps,
+            num_heads=cfg.num_heads,
+            itemsize=jnp.dtype(dt).itemsize)
     else:
         Tbuf = kc.shape[2]
         rows = jnp.arange(S)
@@ -430,12 +447,9 @@ def _decode_tokens_cached(cfg, params, tok, t, kc, vc, ck, cv, src_valid,
     if paged:
         # write coordinates, shared by every layer: position p lands in
         # page pages[s, p // ps] at offset p % ps; entries beyond the
-        # table (or holding the sentinel) become OOB and DROP
-        page_slot = pos // ps
-        pg = jnp.take_along_axis(pages, jnp.clip(page_slot, 0, P - 1),
-                                 axis=1)
-        pg = jnp.where((page_slot < P) & (pg < pool), pg, pool)
-        off = pos % ps
+        # table (or holding the sentinel) become OOB and DROP —
+        # sentinel semantics owned by ops/pallas_paged_attention
+        pg, off = _ppa.sentinel_write_coords(pages, pos, ps, pool)
 
     def _unrolled_attn(q, k_all, v_all, masks):
         outs = [_attention(q[:, g:g + 1], k_all, v_all, masks[g],
@@ -450,15 +464,19 @@ def _decode_tokens_cached(cfg, params, tok, t, kc, vc, ck, cv, src_valid,
         if paged:
             kc = kc.at[i, pg, off].set(k_t, mode="drop")
             vc = vc.at[i, pg, off].set(v_t, mode="drop")
-            k_all = jnp.take(kc[i], safe_pages,
-                             axis=0).reshape(S, Tbuf, D)
-            v_all = jnp.take(vc[i], safe_pages,
-                             axis=0).reshape(S, Tbuf, D)
+            if impl == "kernel":
+                y = _ppa.paged_decode_attention(
+                    q, kc[i], vc[i], pages, pos,
+                    num_heads=cfg.num_heads, page_size=ps,
+                    impl="kernel")
+            else:
+                k_all = _ppa.paged_gather(kc[i], pages)
+                v_all = _ppa.paged_gather(vc[i], pages)
+                y = _unrolled_attn(q, k_all, v_all, q_masks)
         else:
             kc = kc.at[i, rows[:, None], pos].set(k_t, mode="drop")
             vc = vc.at[i, rows[:, None], pos].set(v_t, mode="drop")
-            k_all, v_all = kc[i], vc[i]
-        y = _unrolled_attn(q, k_all, v_all, q_masks)
+            y = _unrolled_attn(q, kc[i], vc[i], q_masks)
         x = _layer_norm(x + y @ a["wo"].astype(dt),
                         p["ln1"]["s"].astype(dt), p["ln1"]["b"].astype(dt))
         c = p["cross"]

@@ -71,6 +71,13 @@ class NMTDecodeProgram(DecodeProgram):
     ``max_len`` so the gathered attention buffer has exactly the dense
     buffer's width (the bit-identity contract rides on matching
     shapes).
+
+    ``attn_impl`` ('auto' | 'kernel' | 'einsum', None = 'auto';
+    ``PARALLAX_PAGED_ATTN`` env var overrides) picks the paged
+    self-attention executor: 'kernel' is the fused Pallas decode
+    kernel (ops/pallas_paged_attention) streaming only live pages
+    through VMEM, 'einsum' the full-width gather. Greedy tokens are
+    identical either way; 'kernel' without paging refuses loudly.
     """
 
     def __init__(self, cfg: nmt.NMTConfig, max_src_len: int,
@@ -80,7 +87,8 @@ class NMTDecodeProgram(DecodeProgram):
                  prefill_chunk_layers: Optional[int] = None,
                  spec_tokens: int = 0,
                  draft_cfg: Optional[nmt.NMTConfig] = None,
-                 draft_params: Any = None):
+                 draft_params: Any = None,
+                 attn_impl: Optional[str] = None):
         self.cfg = cfg
         self.Ts = int(max_src_len)
         self.max_len = int(max_len or cfg.max_len)
@@ -122,6 +130,26 @@ class NMTDecodeProgram(DecodeProgram):
                     f"max-length sequence ({self.pages_per_seq} pages)")
         elif pool_pages is not None:
             raise ValueError("pool_pages given without page_size")
+
+        # -- paged-attention executor (ops/pallas_paged_attention) --------
+        # 'kernel' streams only live pages through the fused Pallas
+        # decode kernel, 'einsum' keeps the full-width gather, 'auto'
+        # (None) resolves per backend + VMEM fit at trace time; the
+        # PARALLAX_PAGED_ATTN env var overrides all of them. Identical
+        # greedy tokens either way — the knob trades HBM traffic, not
+        # output. Resolved inside the existing step/verify traces, so
+        # the jitted signature set is unchanged and stays AOT-closed.
+        if attn_impl is not None and attn_impl not in (
+                "auto", "kernel", "einsum"):
+            raise ValueError(
+                f"attn_impl={attn_impl!r}: expected 'auto', 'kernel' "
+                f"or 'einsum'")
+        if attn_impl == "kernel" and not self.paged:
+            raise ValueError(
+                "attn_impl='kernel' requires the paged KV layout "
+                "(page_size/pool_pages): the kernel's operand is the "
+                "page-table-addressed pool")
+        self.attn_impl = attn_impl
 
         # -- chunked prefill ----------------------------------------------
         L = cfg.num_layers
@@ -351,7 +379,7 @@ class NMTDecodeProgram(DecodeProgram):
                 self.cfg, params, tok[:, None], t, state["kc"],
                 state["vc"], state["ck"], state["cv"],
                 state["src_valid"], pages=pages,
-                page_size=self.page_size)
+                page_size=self.page_size, attn_impl=self.attn_impl)
             logits = logits[:, 0]
         else:
             logits, kc, vc = nmt._decode_step_cached_multi(
@@ -379,7 +407,8 @@ class NMTDecodeProgram(DecodeProgram):
             self.cfg, params, toks, t, state["kc"], state["vc"],
             state["ck"], state["cv"], state["src_valid"],
             pages=pages if self.paged else None,
-            page_size=self.page_size if self.paged else None)
+            page_size=self.page_size if self.paged else None,
+            attn_impl=self.attn_impl if self.paged else None)
         y = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [S, G]
         out = dict(state)
         out["kc"], out["vc"] = kc, vc

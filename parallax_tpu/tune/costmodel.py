@@ -154,6 +154,15 @@ class CostInputs:
     # kernel too.
     lstm_stream_bytes: float = 0.0
     lstm_resident_bytes: float = 0.0
+    # Paged-attention kernel HBM traffic (same blind spot, same fix:
+    # ops/pallas_paged_attention.kernel_hbm_bytes via its trace
+    # records). Only impl='kernel' records are priced — the einsum
+    # gather is ordinary XLA cost_analysis DOES see. Priced at the
+    # table-width upper bound (all entries live): occupancy is
+    # runtime-dynamic and invisible to a lowered-only probe, and an
+    # upper bound keeps the roofline conservative. Mesh-global,
+    # stream-like (splits across devices with the batch).
+    attn_stream_bytes: float = 0.0
     probe_dp: int = 1
     probe_tp: int = 1
     num_devices: int = 1
@@ -276,7 +285,9 @@ def predict(plan: Plan, inputs: CostInputs) -> PlanCost:
     # device, so the mesh-global total is resident * n
     lstm_bytes = (float(inp.lstm_stream_bytes)
                   + float(inp.lstm_resident_bytes) * n)
-    hbm_s = (float(inp.hbm_bytes) + lstm_bytes) / (n * inp.hbm_bps)
+    attn_bytes = float(inp.attn_stream_bytes)
+    hbm_s = (float(inp.hbm_bytes) + lstm_bytes + attn_bytes) \
+        / (n * inp.hbm_bps)
 
     # dense (non-table) grads: full-mesh ring in every run option (the
     # batch axis spans the whole mesh, so every device holds a full
@@ -339,6 +350,8 @@ def predict(plan: Plan, inputs: CostInputs) -> PlanCost:
         # the pallas-LSTM kernel's share of the HBM ceiling, so the
         # tune_decision artifact shows the kernel was priced
         "hbm_lstm_kernel_s": lstm_bytes / (n * inp.hbm_bps) / r_on,
+        # same pattern for the paged-attention decode kernel
+        "hbm_attn_kernel_s": attn_bytes / (n * inp.hbm_bps) / r_on,
         "wire_dense_s": wire_dense / (n * inp.ici_bps) / r_wire,
         "wire_zero_shard_s": wire_zero / (n * inp.ici_bps) / r_wire,
         "wire_table_s": wire_table / (n * inp.ici_bps) / r_wire,
@@ -417,6 +430,24 @@ def inputs_from_engine(engine, tune_config=None,
             lstm_resident += acct["resident_bytes_per_device"]
     except Exception:   # never fail plan pricing for the hint term
         pass
+    # paged-attention kernel traffic (ops/pallas_paged_attention trace
+    # records, impl='kernel' only — the einsum executor is ordinary
+    # XLA that cost_analysis prices itself). Records dedup by static
+    # signature, so identical decoder layers collapse to one record
+    # (the lstm precedent); live pages are runtime-dynamic, so each
+    # record prices at the table-width upper bound.
+    attn_stream = 0.0
+    try:
+        from parallax_tpu.ops import pallas_paged_attention
+        for rec in pallas_paged_attention.trace_records(mesh):
+            if rec["impl"] != "kernel":
+                continue
+            acct = pallas_paged_attention.kernel_hbm_bytes(
+                rec["S"], rec["G"], rec["D"], rec["page_size"],
+                rec["S"] * rec["P"], rec["itemsize"])
+            attn_stream += acct["total_bytes"]
+    except Exception:   # never fail plan pricing for the hint term
+        pass
     dev = jax.devices()[0]
     import os
     peak = flops_lib.device_peak_flops(
@@ -429,6 +460,7 @@ def inputs_from_engine(engine, tune_config=None,
         sparse_fwd_bytes=sparse_fwd, sparse_repl_bytes=sparse_repl,
         lstm_stream_bytes=lstm_stream,
         lstm_resident_bytes=lstm_resident,
+        attn_stream_bytes=attn_stream,
         probe_dp=int(mesh.shape[mesh_lib.AXIS_REPL]),
         probe_tp=int(mesh.shape[mesh_lib.AXIS_SHARD]),
         num_devices=mesh_lib.num_devices(mesh),

@@ -133,6 +133,79 @@ def test_pallas_lstm_recompute_fallback_lowers_for_tpu():
         "tpu_custom_call")
 
 
+def test_paged_attention_kernel_lowers_for_tpu():
+    """ISSUE 16: the fused paged-attention decode kernel at the
+    flagship decode shape (bf16, 2048-cap 128-token pages, spec-verify
+    width 3) — scalar-prefetch page-table index maps, equal-dims K/V
+    page blocks, (H, G, LANES) softmax scratch all lower through
+    Mosaic. Exactly ONE custom call: the whole page sweep is a single
+    kernel, never one call per page."""
+    from parallax_tpu.ops import pallas_paged_attention as ppa
+
+    F = ppa.FLAGSHIP_DECODE
+    args = (jax.ShapeDtypeStruct((F["S"], F["G"], F["D"]),
+                                 jnp.bfloat16),
+            jax.ShapeDtypeStruct((F["pool_pages"], F["page_size"],
+                                  F["D"]), jnp.bfloat16),
+            jax.ShapeDtypeStruct((F["pool_pages"], F["page_size"],
+                                  F["D"]), jnp.bfloat16),
+            jax.ShapeDtypeStruct((F["S"], F["P"]), jnp.int32),
+            jax.ShapeDtypeStruct((F["S"], F["G"]), jnp.int32))
+    text = _export_tpu(
+        lambda q, kp, vp, pages, pos: ppa.paged_decode_attention(
+            q, kp, vp, pages, pos, num_heads=F["num_heads"],
+            page_size=F["page_size"], impl="kernel",
+            interpret=False), *args)
+    assert text.count("tpu_custom_call") == 1, text.count(
+        "tpu_custom_call")
+
+
+def test_paged_attention_single_token_lowers_for_tpu():
+    """The plain (non-speculative) decode step is G=1 — a different
+    block shape for q/out and the softmax scratch; it must lower on
+    its own, not just at the verify width."""
+    from parallax_tpu.ops import pallas_paged_attention as ppa
+
+    F = ppa.FLAGSHIP_DECODE
+    args = (jax.ShapeDtypeStruct((F["S"], 1, F["D"]), jnp.bfloat16),
+            jax.ShapeDtypeStruct((F["pool_pages"], F["page_size"],
+                                  F["D"]), jnp.bfloat16),
+            jax.ShapeDtypeStruct((F["pool_pages"], F["page_size"],
+                                  F["D"]), jnp.bfloat16),
+            jax.ShapeDtypeStruct((F["S"], F["P"]), jnp.int32),
+            jax.ShapeDtypeStruct((F["S"], 1), jnp.int32))
+    text = _export_tpu(
+        lambda q, kp, vp, pages, pos: ppa.paged_decode_attention(
+            q, kp, vp, pages, pos, num_heads=F["num_heads"],
+            page_size=F["page_size"], impl="kernel",
+            interpret=False), *args)
+    assert text.count("tpu_custom_call") == 1, text.count(
+        "tpu_custom_call")
+
+
+def test_paged_attention_einsum_fallback_has_no_custom_call():
+    """The einsum executor is the refusal/off-TPU fallback — it must
+    stay pure XLA (zero Mosaic kernels) so 'einsum' really means 'no
+    Pallas in the program'."""
+    from parallax_tpu.ops import pallas_paged_attention as ppa
+
+    F = ppa.FLAGSHIP_DECODE
+    args = (jax.ShapeDtypeStruct((F["S"], F["G"], F["D"]),
+                                 jnp.bfloat16),
+            jax.ShapeDtypeStruct((F["pool_pages"], F["page_size"],
+                                  F["D"]), jnp.bfloat16),
+            jax.ShapeDtypeStruct((F["pool_pages"], F["page_size"],
+                                  F["D"]), jnp.bfloat16),
+            jax.ShapeDtypeStruct((F["S"], F["P"]), jnp.int32),
+            jax.ShapeDtypeStruct((F["S"], F["G"]), jnp.int32))
+    exp = jax.export.export(jax.jit(
+        lambda q, kp, vp, pages, pos: ppa.paged_decode_attention(
+            q, kp, vp, pages, pos, num_heads=F["num_heads"],
+            page_size=F["page_size"], impl="einsum")),
+        platforms=["tpu"])(*args)
+    assert exp.mlir_module().count("tpu_custom_call") == 0
+
+
 def test_hybrid_engine_step_lowers_for_tpu():
     """The WHOLE flagship-path training step — hybrid plan, slices
     sparse grads, 8-device (repl x shard) mesh — lowers for a TPU

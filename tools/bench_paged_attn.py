@@ -1,0 +1,193 @@
+"""Paged-attention decode-step A/B: Pallas kernel vs einsum gather.
+
+ISSUE 16 acceptance rig: times one paged self-attention decode step
+(``ops/pallas_paged_attention.paged_decode_attention``) under both
+executors — ``impl='kernel'`` (stream only live pages through VMEM)
+and ``impl='einsum'`` (the full-width clip-then-mask gather the kernel
+replaces) — at several pool occupancies. The kernel's claim is
+occupancy-PROPORTIONAL traffic, so the A/B is run at 25%, 50% and
+100% live pages; the einsum path's cost is occupancy-flat by
+construction. The analytic HBM table at the true flagship decode
+shape rides along (``kernel_hbm_bytes`` / ``gather_hbm_bytes`` —
+exact byte accounting, not a measurement).
+
+HONESTY: on the CPU rig the kernel runs in interpret mode, so the
+measured ratios price the *interpreter emulation*, not the TPU memory
+system the kernel exists for — every ratio is stamped CPU-relative
+and the regression gate tracks cross-round DRIFT, never the absolute.
+``interpret_tax`` is the in-artifact witness: the kernel/einsum ratio
+at 100% occupancy, where BOTH paths touch the same KV bytes on
+hardware — the residual gap there IS the emulation constant, which
+explains why ``kernel_over_einsum`` can read > 1 on this rig while
+the analytic table (the hardware claim) scales with occupancy.
+
+Keys consumed by bench.py's ``attn`` block and gated by
+tools/check_regression.py: ``step_ms.kernel`` (lower is better) and
+``kernel_over_einsum`` (two-sided drift — measured at 50% occupancy,
+the sparse regime the kernel exists for).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# op-level A/B shape: flagship-proportioned (G = spec verify width 3,
+# 8-token pages, table width 8) but sized so the CPU interpreter
+# finishes in seconds; pool is ~2.5x one batch's table footprint so
+# live pages scatter non-contiguously like a real pool
+OP_SHAPE = dict(S=8, G=3, D=128, num_heads=4, page_size=16, P=8,
+                pool_pages=160)
+
+OCCUPANCIES = (0.25, 0.5, 1.0)
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def measure_op(repeats: int = 7, shape=None):
+    """Median wall ms of one paged decode-step attention per executor
+    per occupancy.
+
+    PARALLAX_PAGED_ATTN is snapshotted and CLEARED for the duration:
+    the env override outranks the impl argument, so an ambient setting
+    (the documented operational escape hatch) would silently collapse
+    both A/B arms onto one executor and feed the drift gate a fake
+    ~1.0 ratio."""
+    prior = os.environ.pop("PARALLAX_PAGED_ATTN", None)
+    try:
+        return _measure_op(repeats, shape)
+    finally:
+        if prior is not None:
+            os.environ["PARALLAX_PAGED_ATTN"] = prior
+
+
+def _measure_op(repeats, shape):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from parallax_tpu.ops import pallas_paged_attention as ppa
+
+    s = dict(OP_SHAPE, **(shape or {}))
+    S, G, D = s["S"], s["G"], s["D"]
+    H, ps, P, pool = (s["num_heads"], s["page_size"], s["P"],
+                      s["pool_pages"])
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((S, G, D)) * 0.2, jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((pool, ps, D)) * 0.2,
+                     jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((pool, ps, D)) * 0.2,
+                     jnp.float32)
+
+    def rig(occ):
+        n_live = max(1, int(round(occ * P)))
+        pages = np.full((S, P), pool, np.int32)
+        for i in range(S):
+            pages[i, :n_live] = rng.choice(pool, n_live, replace=False)
+        pos = np.full((S, G), n_live * ps - 1, np.int32)
+        return jnp.asarray(pages), jnp.asarray(pos)
+
+    def timed(fn, *args):
+        jax.block_until_ready(fn(*args))               # compile
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            times.append((time.perf_counter() - t0) * 1e3)
+        return round(_median(times), 3)
+
+    def step_fn(impl):
+        return jax.jit(lambda q, kp, vp, pages, pos:
+                       ppa.paged_decode_attention(
+                           q, kp, vp, pages, pos, num_heads=H,
+                           page_size=ps, impl=impl))
+
+    sweep = {}
+    for occ in OCCUPANCIES:
+        pages, pos = rig(occ)
+        sweep[str(occ)] = {
+            "kernel": timed(step_fn("kernel"), q, kp, vp, pages, pos),
+            "einsum": timed(step_fn("einsum"), q, kp, vp, pages, pos),
+        }
+    return sweep, s
+
+
+def flagship_hbm_story():
+    """The analytic per-decode-step HBM bytes at the TRUE flagship
+    decode shape (bf16, ops/pallas_paged_attention.FLAGSHIP_DECODE)
+    across occupancies — live-pages-only kernel stream vs the
+    occupancy-flat full-width gather. Exact byte accounting from the
+    kernel's block/stream structure; the hardware claim the measured
+    CPU ratios cannot make."""
+    from parallax_tpu.ops import pallas_paged_attention as ppa
+
+    F = ppa.FLAGSHIP_DECODE
+    S, G, D = F["S"], F["G"], F["D"]
+    ps, P = F["page_size"], F["P"]
+    gather = ppa.gather_hbm_bytes(S, G, D, ps, P, 2)["total_bytes"]
+    rows = {}
+    for occ in OCCUPANCIES:
+        live = int(round(occ * S * P))
+        kern = ppa.kernel_hbm_bytes(S, G, D, ps, live,
+                                    2)["total_bytes"]
+        rows[str(occ)] = {
+            "kernel_bytes": kern,
+            "gather_bytes": gather,
+            "kernel_over_gather": round(kern / gather, 4),
+        }
+    return {
+        "shape": dict(F, dtype="bfloat16"),
+        "per_step": rows,
+        "basis": ("analytic page-stream accounting (exact for the "
+                  "kernel's one-block-per-live-page structure; the "
+                  "gather side counts the pool read, the materialized "
+                  "K/V view write and the attention re-read); both "
+                  "sides exclude the q/k/v projections and output "
+                  "matmul each path equally pays; not a measurement"),
+    }
+
+
+def measure():
+    import jax
+
+    sweep, shape = measure_op()
+    on_cpu = jax.devices()[0].platform == "cpu"
+    mid = sweep[str(0.5)]
+    full = sweep[str(1.0)]
+    return {
+        "platform": jax.devices()[0].platform,
+        "op_shape": shape,
+        "occupancy_sweep_ms": sweep,
+        # the gated pair, at the sparse occupancy the kernel exists
+        # for (50% live pages)
+        "step_ms": {"kernel": mid["kernel"], "einsum": mid["einsum"]},
+        "kernel_over_einsum": (
+            round(mid["kernel"] / mid["einsum"], 4)
+            if mid["einsum"] else None),
+        # equal-bytes witness: at 100% occupancy both executors touch
+        # the same KV bytes on hardware, so this ratio is the
+        # interpreter emulation constant on the CPU rig
+        "interpret_tax": (
+            round(full["kernel"] / full["einsum"], 4)
+            if full["einsum"] else None),
+        "hbm_bytes_flagship": flagship_hbm_story(),
+        "note": ("CPU rig runs the kernel in interpret mode: the "
+                 "measured ratios price the interpreter emulation "
+                 "(interpret_tax is the witness — the kernel/einsum "
+                 "ratio at equal-bytes 100% occupancy), NOT the HBM "
+                 "economics the kernel exists for; cross-round DRIFT "
+                 "is the gated signal and the analytic "
+                 "hbm_bytes_flagship block is the hardware claim"
+                 if on_cpu else "measured on accelerator"),
+    }
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps(measure(), indent=2))

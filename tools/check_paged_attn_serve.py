@@ -1,0 +1,172 @@
+"""Paged-attention serve guard: kernel executor == einsum executor,
+token for token, with zero serve-time compiles and zero leaked pages.
+
+ISSUE 16 acceptance, enforced in tier-1
+(tests/test_paged_attn.py::test_paged_attn_serve_guard) and runnable
+directly::
+
+    JAX_PLATFORMS=cpu python tools/check_paged_attn_serve.py
+
+Two sessions over the FULL high-concurrency rig (paged KV pool +
+chunked prefill + speculative decoding, tools/loadgen.py) fed the
+EXACT same deterministic request stream — one with
+``attn_impl='einsum'`` (the full-width gather), one with
+``attn_impl='kernel'`` (the fused Pallas decode kernel,
+ops/pallas_paged_attention; interpret mode off-TPU). Three contracts:
+
+* **exact tokens** — every request's output stream is identical under
+  both executors: the kernel is an HBM-traffic optimization, never a
+  result change. The rig pins ``compute_dtype=float32``, where the
+  token-identity contract is exact (under bf16 the two executors
+  differ within rounding noise — see the module docstring of
+  ops/pallas_paged_attention).
+* **closed signature set** — the kernel path resolves INSIDE the
+  existing step/verify traces, so the jitted signature set is
+  unchanged: the ``jax.monitoring`` backend-compile witness (activated
+  after session construction, when AOT warmup has legitimately
+  compiled everything) stays at 0 across both sessions, and
+  ``serve.recompiles`` stays 0.
+* **zero leaked pages** — after close, both sessions' pool allocators
+  report ``in_use == 0``: the executor switch cannot change page
+  accounting (it only changes how pages are READ).
+
+A second, mid-churn phase re-submits half the stream against the
+kernel session (slots refill, pages recycle through the free list) and
+re-diffs against the einsum session's same re-submission — stale-page
+reuse must stay invisible through the kernel's in-kernel masking
+exactly as it is through clip-then-mask.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_compile_events = {"n": 0, "active": False}
+
+
+def _install_listener():
+    import jax
+
+    def _listen(event, duration, **kw):
+        if _compile_events["active"] and "backend_compile" in event:
+            _compile_events["n"] += 1
+
+    jax.monitoring.register_event_duration_secs_listener(_listen)
+
+
+def _serve_round(sess, feeds, caps, timeout_s: float = 300.0):
+    reqs = [sess.submit(f, max_new_tokens=c)
+            for f, c in zip(feeds, caps)]
+    return [[int(t) for t in r.result(timeout=timeout_s)]
+            for r in reqs]
+
+
+def _rig(attn_impl: str, slots: int = 4):
+    import jax.numpy as jnp
+
+    from tools import loadgen
+    return loadgen.demo_decode_session(
+        slots=slots, T=12, Ts=8, page_size=4,
+        model_dim=32, num_layers=2, vocab=64,
+        prefill_chunk_layers=1, spec_tokens=2,
+        attn_impl=attn_impl, compute_dtype=jnp.float32)
+
+
+def measure(n_requests: int = 10) -> dict:
+    _install_listener()
+
+    def run_session(attn_impl):
+        sess, make_feed = _rig(attn_impl)
+        feeds = [make_feed(i) for i in range(n_requests)]
+        caps = [7 if i % 2 else 12 for i in range(n_requests)]
+        try:
+            _compile_events["n"] = 0
+            _compile_events["active"] = True
+            outs = _serve_round(sess, feeds, caps)
+            # churn: re-submit half the stream so slots refill and
+            # pages recycle through the free list with stale content
+            outs2 = _serve_round(sess, feeds[: n_requests // 2],
+                                 caps[: n_requests // 2])
+            _compile_events["active"] = False
+            stats = sess.stats()
+            alloc = sess._scheduler._alloc
+            return {"outs": outs, "outs2": outs2,
+                    "compiles": _compile_events["n"],
+                    "recompiles": stats.get("serve.recompiles", 0),
+                    "completed": stats.get("serve.completed", 0),
+                    "pages_in_use_after_close": None,
+                    "_alloc": alloc}
+        finally:
+            sess.close()
+
+    ein = run_session("einsum")
+    ein["pages_in_use_after_close"] = ein.pop("_alloc").in_use
+    ker = run_session("kernel")
+    ker["pages_in_use_after_close"] = ker.pop("_alloc").in_use
+
+    mism = sum(1 for a, b in zip(ein["outs"], ker["outs"]) if a != b)
+    mism2 = sum(1 for a, b in zip(ein["outs2"], ker["outs2"])
+                if a != b)
+    return {
+        "requests": n_requests,
+        "token_mismatches": mism,
+        "token_mismatches_churn": mism2,
+        "tokens_decoded": sum(len(o) for o in ker["outs"]
+                              + ker["outs2"]),
+        "einsum": {k: ein[k] for k in
+                   ("compiles", "recompiles", "completed",
+                    "pages_in_use_after_close")},
+        "kernel": {k: ker[k] for k in
+                   ("compiles", "recompiles", "completed",
+                    "pages_in_use_after_close")},
+    }
+
+
+def check(result: dict) -> list:
+    """-> list of violated invariants (empty = pass)."""
+    bad = []
+    if result["token_mismatches"] != 0:
+        bad.append(f"{result['token_mismatches']} request(s) decoded "
+                   f"DIFFERENT tokens under attn_impl='kernel' vs "
+                   f"'einsum' — the executor changed results")
+    if result["token_mismatches_churn"] != 0:
+        bad.append(f"{result['token_mismatches_churn']} churn-round "
+                   f"mismatch(es) — stale recycled pages leaked "
+                   f"through the kernel's masking")
+    for name in ("einsum", "kernel"):
+        r = result[name]
+        if r["compiles"] != 0:
+            bad.append(f"{r['compiles']} XLA compile(s) fired during "
+                       f"{name}-executor serving — the executor "
+                       f"switch leaked a signature past AOT warmup")
+        if r["recompiles"] != 0:
+            bad.append(f"serve.recompiles = {r['recompiles']} "
+                       f"({name} rig)")
+        if r["pages_in_use_after_close"] != 0:
+            bad.append(f"{r['pages_in_use_after_close']} page(s) "
+                       f"leaked after close ({name} rig)")
+        if not r["completed"]:
+            bad.append(f"no request completed ({name} rig)")
+    return bad
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=10)
+    args = ap.parse_args(argv)
+    result = measure(n_requests=args.requests)
+    violations = check(result)
+    result["violations"] = violations
+    result["ok"] = not violations
+    print(json.dumps(result, indent=2, default=str))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -260,6 +260,16 @@ SECONDARY_GATES = (
     # (kernel on TPU, residual-scan off-TPU) — a ratio creeping back
     # toward 1 means the residual backward is losing its edge
     ("lstm.auto_over_recompute", False),
+    # paged-attention decode (ISSUE 16, bench "attn" block): the
+    # kernel's decode-step time must not quietly slow down, and the
+    # kernel-over-einsum ratio is gated in BOTH directions — the
+    # two-row two-sided drift pattern (the absolute is CPU-relative
+    # on the CPU rig, where it prices the interpreter emulation, not
+    # the live-pages-only HBM economics; a drifting ratio means one
+    # of the two executors moved)
+    ("attn.step_ms.kernel", False),
+    ("attn.kernel_over_einsum", False),
+    ("attn.kernel_over_einsum", True),
 )
 
 

@@ -221,7 +221,8 @@ def demo_decode_session(slots: int = 16, T: int = 16, Ts: int = 8,
                         prefix_cache: bool = False,
                         prefix_cache_max_pages=None,
                         tenant_quotas=None, slo_classes=None,
-                        metrics=None):
+                        metrics=None, attn_impl=None,
+                        compute_dtype=None):
     """A tiny-NMT continuous-decode session with the full ISSUE 6
     stack on by default — paged KV pool, chunked prefill, layer-skip
     speculative draft — plus the ISSUE 15 knobs (prefix cache, tenant
@@ -237,16 +238,26 @@ def demo_decode_session(slots: int = 16, T: int = 16, Ts: int = 8,
     from parallax_tpu.models import nmt
     from parallax_tpu.serve import NMTDecodeProgram
 
-    cfg = nmt.tiny_config(vocab_size=vocab, model_dim=model_dim,
-                          num_heads=4, mlp_dim=2 * model_dim,
-                          num_layers=num_layers, max_len=max(T, Ts),
-                          num_partitions=1)
+    cfg_kw = dict(vocab_size=vocab, model_dim=model_dim,
+                  num_heads=4, mlp_dim=2 * model_dim,
+                  num_layers=num_layers, max_len=max(T, Ts),
+                  num_partitions=1)
+    if compute_dtype is not None:
+        # executor A/B rigs pin float32: the kernel/einsum token-
+        # identity contract is exact there (bf16 differs within
+        # rounding noise — see ops/pallas_paged_attention)
+        cfg_kw.update(compute_dtype=compute_dtype)
+    cfg = nmt.tiny_config(**cfg_kw)
     params = nmt.build_model(cfg).init_fn(jax.random.PRNGKey(0))
     kw = {}
     if paged:
         if pool_pages is None:
             pool_pages = slots * (T // page_size)
         kw.update(page_size=page_size, pool_pages=pool_pages)
+    if attn_impl is not None:
+        # paged-attention executor A/B ('kernel' | 'einsum' | 'auto');
+        # see ops/pallas_paged_attention and tools/check_paged_attn_serve
+        kw.update(attn_impl=attn_impl)
     if prefill_chunk_layers:
         kw.update(prefill_chunk_layers=prefill_chunk_layers)
     if speculative and spec_tokens:
