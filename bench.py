@@ -762,6 +762,26 @@ def worker_main():
             print(f"# ckpt bench failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
 
+    # Numerics observatory block (ISSUE 17): per-layer stats trail
+    # analysis on the sampled simple-model rig (which layer, which
+    # risk), both kernel-drift sentinels clean AND with an injected
+    # perturbation (clean must stay silent, perturbed must flag), and
+    # the host-side per-sample consume cost. tools/check_regression.py
+    # secondary-gates the sentinels' accuracy (two-sided drift: the
+    # agreement is CPU-relative under Pallas interpret mode, so
+    # cross-round DRIFT is the signal) and numerics.consume_us.
+    # PARALLAX_BENCH_NUMERICS=0 skips. No BENCH_VERSION bump: new
+    # block, gate-side skip.
+    numerics_snap = None
+    if os.environ.get("PARALLAX_BENCH_NUMERICS", "1") != "0":
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from tools import numerics_report
+            numerics_snap = numerics_report.measure()
+        except Exception as e:
+            print(f"# numerics bench failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
     per_chip = hybrid_wps / n_chips
 
     # Same-round A/B on a bench_version bump (VERDICT r5 item 6): the
@@ -871,6 +891,10 @@ def worker_main():
         # explicit, category shares, dense/sparse split) + per-term
         # cost-model calibration ratios (CPU-relative off-TPU)
         "profile": profile_snap,
+        # numerics observatory (ISSUE 17): per-layer stats attribution
+        # on the sampled rig, drift-sentinel clean/perturbed self-test
+        # (CPU-relative interpret-mode agreement), host consume cost
+        "numerics": numerics_snap,
         # same-round A/B under the previous round's harness params,
         # recorded iff bench_version bumped this round (VERDICT r5
         # item 6); tools/check_regression.py requires it to treat a

@@ -651,6 +651,25 @@ class ParallaxConfig:
     # pipeline never blocks on monitoring. Non-finite values warn
     # immediately and count into the registry (health.*).
     monitor_health: bool = False
+    # Numerics observatory (obs/numwatch.py): every N steps the engine
+    # appends one fused in-graph per-layer stats reduction (grad/param
+    # norm, absmax, non-finite count, bf16 underflow fraction, update
+    # ratio — per param-tree prefix) to the step outputs, consumed
+    # lazily like monitor_health into `numerics.<layer>.*` gauges, a
+    # forensics trail, and anomaly feeds. The sample is FORCED on any
+    # non-finite loss/grad step, so the nonfinite_rollback artifact can
+    # name the first poisoned layer (NaN provenance). 0 (default) =
+    # off: no extra step outputs, no monitor constructed. > 0
+    # auto-enables monitor_health (provenance needs loss_finite).
+    numerics_interval: int = 0
+    # Kernel-drift sentinels (obs/numwatch.py DriftSentinel): every N
+    # HOST steps the session shadow-evals each hand-built Pallas
+    # executor against its reference (LSTM bwd kernel vs scan,
+    # paged-attn kernel vs einsum) and exports rel-error / argmax-flip
+    # gauges. Each sweep runs both executors on the dispatch thread —
+    # whole milliseconds, not micros — so the default 0 keeps it out
+    # of the training loop; tools/bench run sentinels explicitly.
+    numerics_drift_interval: int = 0
     # Override the PARALLAX logger level for this run (default: leave
     # the env-var/import-time level alone). E.g. "DEBUG", "WARNING".
     log_level: Optional[str] = None
@@ -740,6 +759,18 @@ class ParallaxConfig:
         if self.recovery_config.enabled and not self.monitor_health:
             # the policy consumes the in-graph loss_finite/grad_norm
             # outputs; declaring recovery IS declaring health intent
+            self.monitor_health = True
+        if int(self.numerics_interval) < 0:
+            raise ValueError(
+                f"numerics_interval must be >= 0, got "
+                f"{self.numerics_interval}")
+        if int(self.numerics_drift_interval) < 0:
+            raise ValueError(
+                f"numerics_drift_interval must be >= 0, got "
+                f"{self.numerics_drift_interval}")
+        if self.numerics_interval > 0 and not self.monitor_health:
+            # provenance keys off the loss_finite trip and the trail
+            # rides the same lazy-consumption cadence
             self.monitor_health = True
         if self.sparse_grad_mode not in ("dense", "slices"):
             raise ValueError(

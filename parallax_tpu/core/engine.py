@@ -56,7 +56,7 @@ from parallax_tpu.common.lib import parallax_log
 from parallax_tpu.compile import bucketing, warmup as warmup_lib
 from parallax_tpu.core import classify, mesh as mesh_lib, specs as specs_lib
 from parallax_tpu.obs import _state as obs_state, \
-    metrics as obs_metrics, trace
+    metrics as obs_metrics, numwatch, trace
 from parallax_tpu.ops import embedding
 
 
@@ -692,6 +692,30 @@ class Engine:
                 # across both gradient representations.
                 outputs["grad_norm"] = optax.global_norm((grads, gdeltas))
                 outputs["loss_finite"] = jnp.isfinite(loss)
+            if config.numerics_interval > 0 and obs_state.enabled:
+                # numerics observatory (obs/numwatch.py): per-layer
+                # stats tree under an in-graph sampling cond. The key
+                # is ALWAYS present when enabled — AOT executables need
+                # a static output structure — and the killswitch gate
+                # is build-time, so PARALLAX_OBS=0 means zero extra
+                # step outputs (check_obs_overhead asserts this
+                # structurally). The sample is forced on a non-finite
+                # loss/grad step so the rollback forensics always see
+                # the trip step's per-layer evidence. gdeltas (slice
+                # rows, varying shapes) stay out of the per-prefix
+                # stats — the dense grads of a sliced table are zeros
+                # there, not a numerics signal.
+                if "numerics" in metrics:
+                    raise ValueError(
+                        "numerics_interval > 0 reserves the output "
+                        "name 'numerics' but the model's metrics "
+                        "already define it; rename the model metric")
+                outputs["numerics"] = numwatch.step_numerics(
+                    state.params, params, grads,
+                    step=state.step,
+                    interval=config.numerics_interval,
+                    force=~jnp.isfinite(loss)
+                    | ~jnp.isfinite(optax.global_norm((grads, gdeltas))))
             return new_state, outputs
 
         self._init_jit = jax.jit(init_state)

@@ -57,6 +57,16 @@ The plan observatory (ISSUE 13) adds the measured device-side view:
     per-device gauges and the ``oom_risk`` incident, and the budget
     resolution behind the tuner's OOM preflight.
 
+The numerics observatory (ISSUE 17) watches the training math itself:
+
+  * :mod:`~parallax_tpu.obs.numwatch` — per-layer grad/param tree
+    stats sampled in-graph (``Config(numerics_interval=N)``, lazy
+    ``numerics.<layer>.*`` gauges + forensics trail), NaN provenance
+    naming the first non-finite feed/param/grad stage inside the
+    ``nonfinite_rollback`` artifact, kernel-drift sentinels
+    shadow-evaling each Pallas executor against its reference, and
+    the anomaly-fed ``health.instability`` score.
+
 ``disable()`` / ``enable()`` (or env ``PARALLAX_OBS=0``) switch the
 whole layer to near-free no-ops process-wide;
 `tools/check_obs_overhead.py` holds the enabled path to <=2% of step
@@ -65,8 +75,8 @@ wall-time.
 
 from parallax_tpu.obs._state import disable, enable, is_enabled
 from parallax_tpu.obs import (aggregate, anomaly, export, flightrec,
-                              health, memwatch, metrics, reqtrace,
-                              timeline, trace, xprof)
+                              health, memwatch, metrics, numwatch,
+                              reqtrace, timeline, trace, xprof)
 from parallax_tpu.obs.memwatch import MemWatch
 from parallax_tpu.obs.aggregate import (aggregate_host_step_times,
                                         find_stragglers)
@@ -76,6 +86,8 @@ from parallax_tpu.obs.health import HealthMonitor, device_memory_stats
 from parallax_tpu.obs.metrics import (Counter, Gauge, Histogram,
                                       JsonlSink, MetricsRegistry,
                                       PipelineStats)
+from parallax_tpu.obs.numwatch import (DriftSentinel, NumericsMonitor,
+                                       provenance_report)
 from parallax_tpu.obs.export import TelemetryExporter
 from parallax_tpu.obs.reqtrace import RequestRecord, RequestTraceRing
 from parallax_tpu.obs.timeline import StepTimeline
@@ -84,7 +96,8 @@ from parallax_tpu.obs.trace import (TraceCollector, TraceEvent,
 
 __all__ = [
     "trace", "metrics", "health", "timeline", "flightrec", "anomaly",
-    "aggregate", "reqtrace", "export", "xprof", "memwatch",
+    "aggregate", "reqtrace", "export", "xprof", "memwatch", "numwatch",
+    "NumericsMonitor", "DriftSentinel", "provenance_report",
     "MemWatch", "span", "TraceCollector",
     "TraceEvent", "export_chrome_trace", "MetricsRegistry", "Counter",
     "Gauge", "Histogram", "JsonlSink", "PipelineStats", "HealthMonitor",

@@ -222,6 +222,12 @@ class AnomalyMonitor:
         if event is not None:
             self.registry.counter(
                 f"anomaly.{signal}.{event.kind}s").inc()
+            # per-CLASS totals next to the per-signal counters: the
+            # scrape surface (obs/export.py) needs a bounded-cardinality
+            # incident count — per-signal names explode with the
+            # numerics feeds (one pair per layer), per-class does not
+            self.registry.counter(f"anomaly.events.{event.kind}").inc()
+            self.registry.counter("anomaly.events.total").inc()
             if self._on_event is not None:
                 try:
                     self._on_event(event)

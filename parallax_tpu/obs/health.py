@@ -23,6 +23,7 @@ one snapshot carries it (bench.py, the JSONL sink).
 from __future__ import annotations
 
 import collections
+import math
 import threading
 from typing import Dict, Optional
 
@@ -125,6 +126,21 @@ class HealthMonitor:
         self._norms: collections.deque = collections.deque(maxlen=512)
         self._n_norms = 0
         self.first_nonfinite_step: Optional[int] = None
+        # Instability score (ISSUE 17, the hook ROADMAP item 4's
+        # preemption-aware checkpoint cadence consumes): a bounded
+        # [0, 1) accumulator fed by anomaly events over the numerics
+        # stats (update-ratio / underflow trends, loss and grad-norm
+        # spikes) and by non-finite incidents. Each event of weight w
+        # moves the score toward 1 by a factor (1 - e^-w); every
+        # consumed healthy reading decays it multiplicatively, so a
+        # quiet run returns to ~0 in a few hundred steps while a
+        # streak of anomalies saturates. Plain float, written under
+        # _consume_lock like the rest of the opt-in bookkeeping.
+        self._instability = 0.0
+        self._instability_decay = 0.97
+        self._instability_events = 0
+        registry.gauge("health.instability").set_fn(
+            lambda: round(self._instability, 6))
 
     # -- producer side (dispatch thread) -----------------------------------
 
@@ -206,6 +222,9 @@ class HealthMonitor:
                 self._on_reading(step, loss_f, norm)
             except Exception:
                 pass
+        # healthy readings decay the instability score (the accumulate
+        # side lives in record_instability_event)
+        self._instability *= self._instability_decay
         if finite is False:
             self._n_nonfinite_loss += 1
             self._nonfinite_loss.inc()
@@ -229,11 +248,31 @@ class HealthMonitor:
                 self._fire_nonfinite(step, "grad")
 
     def _fire_nonfinite(self, step: int, kind: str) -> None:
+        self.record_instability_event(1.0)
         if self._on_nonfinite is not None:
             try:
                 self._on_nonfinite(step, kind)
             except Exception:
                 pass
+
+    # -- instability score -------------------------------------------------
+
+    def record_instability_event(self, weight: float = 0.5) -> None:
+        """One anomaly/incident pushes the score toward 1 (bounded);
+        callable from any thread (the anomaly on_event hook fires on
+        whichever thread consumed the reading)."""
+        w = max(float(weight), 0.0)
+        with self._consume_lock:
+            self._instability_events += 1
+            self._instability = 1.0 - (1.0 - self._instability) \
+                * math.exp(-w)
+
+    @property
+    def instability(self) -> float:
+        """Current [0, 1) instability score — 0 = quiet, ~1 = the run
+        is actively misbehaving. ROADMAP item 4's checkpoint cadence
+        contract: save more often while this is high."""
+        return self._instability
 
     def snapshot(self) -> Dict:
         """JSON-able baseline (checkpoint extras): the lifetime
@@ -274,6 +313,8 @@ class HealthMonitor:
             "nonfinite_loss_steps": self._n_nonfinite_loss,
             "nonfinite_grad_steps": self._n_nonfinite_grad,
             "first_nonfinite_step": self.first_nonfinite_step,
+            "instability": round(self._instability, 6),
+            "instability_events": self._instability_events,
             "grad_norm": summarize_window(sorted(self._norms),
                                           self._n_norms),
         }

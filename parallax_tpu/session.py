@@ -55,7 +55,8 @@ from parallax_tpu.core import engine as engine_lib, mesh as mesh_lib
 from parallax_tpu.ckpt import CheckpointHook, RecoveryPolicy, \
     RecoverySurrender
 from parallax_tpu.obs import aggregate as aggregate_lib, \
-    memwatch as memwatch_lib, trace, xprof
+    memwatch as memwatch_lib, numwatch as numwatch_lib, trace, xprof
+from parallax_tpu.obs._state import is_enabled as obs_enabled
 from parallax_tpu.obs.anomaly import AnomalyMonitor
 from parallax_tpu.obs.flightrec import FlightRecorder
 from parallax_tpu.obs.health import HealthMonitor, device_memory_stats
@@ -367,6 +368,22 @@ class ParallaxSession:
             self.metrics, on_nonfinite=self._on_nonfinite,
             on_reading=self._on_health_reading)
             if config.monitor_health else None)
+        # -- numerics observatory (obs/numwatch, ISSUE 17) -------------
+        # Constructed ONLY when enabled AND obs is on: with
+        # PARALLAX_OBS=0 no consumer, replay cache, or sentinel
+        # machinery exists at all (check_obs_overhead asserts this
+        # structurally), matching the engine's build-time output gate.
+        self.numerics = (numwatch_lib.NumericsMonitor(
+            self.metrics, config.numerics_interval,
+            anomaly=self.anomaly)
+            if config.numerics_interval > 0 and obs_enabled() else None)
+        # last dispatched batch, kept one step for NaN provenance (the
+        # engine does not donate batches, so the arrays stay readable)
+        self._numerics_last_batch: Optional[tuple] = None
+        self._drift_sentinels: Optional[List] = None
+        self._drift_results: Optional[List[Dict]] = None
+        if self.numerics is not None:
+            self.flight.add_provider("numerics", self._numerics_for_dump)
         self._metrics_sink = (
             JsonlSink(self.metrics, config.metrics_path,
                       config.metrics_interval_s,
@@ -825,8 +842,10 @@ class ParallaxSession:
                     # Block so step timing / traces cover real device
                     # work.
                     tb = time.perf_counter()
-                    outputs = {k: np.asarray(v)
-                               for k, v in outputs.items()}
+                    # tree_map, not a flat dict-comp: the numerics
+                    # output is itself a stats tree
+                    outputs = jax.tree_util.tree_map(np.asarray,
+                                                     outputs)
                     blocked_s = time.perf_counter() - tb
                     self.pipeline_stats.record_blocked(blocked_s)
         except Exception as e:
@@ -862,6 +881,14 @@ class ParallaxSession:
         self.memwatch.sample(step)
         self._profile.after_step(step)
         self._last_outputs = outputs
+        if self.numerics is not None:
+            # cache the batch BEFORE recovery looks at the outputs: if
+            # this step trips, provenance sweeps exactly these feeds
+            self._numerics_last_batch = (step, batch)
+            self.numerics.observe(step, outputs.get("numerics"))
+            di = self._config.numerics_drift_interval
+            if di and step and step % di == 0:
+                self._run_drift_sentinels_guarded(step)
         new_step = step + 1
         self._host_step = new_step
         self._data_cursor += 1
@@ -1153,6 +1180,11 @@ class ParallaxSession:
                 # same class of live-state race as the overflow gauge
                 # above: a poisoned buffer must not kill the caller
                 pass
+        if self.numerics is not None:
+            try:
+                self.numerics.poll()
+            except Exception:
+                pass
         return self.metrics.snapshot()
 
     # -- training forensics (obs/) ----------------------------------------
@@ -1163,6 +1195,15 @@ class ParallaxSession:
             "anomaly: %s %s at step %d — value %.4g vs baseline %.4g "
             "(%.2fx)", event.signal, event.kind, event.step, event.value,
             event.baseline, event.ratio)
+        if self.health is not None:
+            # anomaly events feed the instability score (ROADMAP item
+            # 4's cadence hook): numerics trends (update-ratio /
+            # underflow per layer) weigh more than a step-time blip —
+            # they are the signals that precede a blow-up. Non-finite
+            # incidents add weight 1.0 inside HealthMonitor itself.
+            self.health.record_instability_event(
+                0.5 if event.signal.startswith(("numerics.", "loss",
+                                                "grad_norm")) else 0.25)
         self.flight.trigger(
             f"anomaly_{event.signal}_{event.kind}",
             {"signal": event.signal, "kind": event.kind,
@@ -1180,6 +1221,68 @@ class ParallaxSession:
             self.anomaly.observe("loss", step, float(loss))
         if grad_norm is not None and np.isfinite(grad_norm):
             self.anomaly.observe("grad_norm", step, float(grad_norm))
+
+    # -- numerics observatory (obs/numwatch, ISSUE 17) --------------------
+
+    def _numerics_provenance(self, step: int, kind: str,
+                             outputs) -> Dict:
+        """Blast-radius sweep for the nonfinite_rollback artifact: the
+        cached offending batch, the (pre-rollback) param tree, the trip
+        step's forced in-graph grad stats, and the loss, in dataflow
+        order. Blocking — the rollback is already stalling dispatch."""
+        batch = None
+        if (self._numerics_last_batch is not None
+                and self._numerics_last_batch[0] == step):
+            batch = self._numerics_last_batch[1]
+        return numwatch_lib.provenance_report(
+            feeds=batch,
+            params=(self._state.params
+                    if self._state is not None else None),
+            trip_stats=outputs.get("numerics"),
+            loss=outputs.get("loss"),
+            step=step, kind=kind)
+
+    def run_drift_sentinels(self) -> Optional[List[Dict]]:
+        """Shadow-eval every hand-built kernel executor against its
+        reference NOW (LSTM bwd kernel vs scan, paged-attn kernel vs
+        einsum) and return the check results; gauges land as
+        ``numerics.drift.<name>.*``. Runs whole milliseconds of kernel
+        work — the in-loop cadence is ``numerics_drift_interval`` (off
+        by default); this method is the explicit/bench entry point.
+        None when the numerics observatory is off."""
+        if self.numerics is None:
+            return None
+        if self._drift_sentinels is None:
+            self._drift_sentinels = numwatch_lib.default_sentinels(
+                self.metrics)
+        results = [s.check() for s in self._drift_sentinels]
+        self._drift_results = results
+        for r in results:
+            if r["flagged"]:
+                parallax_log.warning(
+                    "numerics: drift sentinel %r flagged — rel_err "
+                    "%.3e (tol %.1e), argmax flips %s", r["name"],
+                    r["rel_err"], r["rel_err_tol"],
+                    r["argmax_flip_frac"])
+                self.flight.trigger(
+                    f"kernel_drift_{r['name']}", dict(r))
+        return results
+
+    def _run_drift_sentinels_guarded(self, step: int) -> None:
+        try:
+            with trace.span("numerics.drift_sweep", step=step):
+                self.run_drift_sentinels()
+        except Exception as e:
+            # a broken shadow-eval must never fail the training step
+            parallax_log.warning("drift sentinel sweep failed: %s", e)
+
+    def _numerics_for_dump(self) -> Optional[Dict]:
+        """Non-blocking numerics flight section (trail + drift)."""
+        if self.numerics is None:
+            return None
+        out = self.numerics.snapshot_for_dump()
+        out["drift"] = self._drift_results
+        return out
 
     # -- checkpoint/recovery (ckpt/) --------------------------------------
 
@@ -1238,11 +1341,24 @@ class ParallaxSession:
             self._recovery.note_good_step()
             self._recovery.maybe_snapshot(self._host_step, self._state)
             return False
-        self.flight.trigger(
-            "nonfinite_rollback",
-            {"step": step, "kind": kind,
-             "snapshot_step": self._recovery.snapshot_step,
-             "data_cursor": self._data_cursor})
+        detail = {"step": step, "kind": kind,
+                  "snapshot_step": self._recovery.snapshot_step,
+                  "data_cursor": self._data_cursor}
+        if self.numerics is not None:
+            # NaN provenance (obs/numwatch.py): this runs BEFORE the
+            # rollback below, so self._state is still the poisoned
+            # post-step tree and the cached batch is the offending one
+            # — the artifact names the first non-finite stage and
+            # carries the stats trail leading in. Guarded: forensics
+            # must never break the recovery they decorate.
+            try:
+                detail["provenance"] = self._numerics_provenance(
+                    step, kind, outputs)
+                self.numerics.poll(block=True)
+                detail["stats_trail"] = self.numerics.trail_tail(16)
+            except Exception as e:
+                detail["provenance_error"] = f"{type(e).__name__}: {e}"
+        self.flight.trigger("nonfinite_rollback", detail)
         try:
             state, snap_step = self._recovery.rollback(step, kind)
         except RecoverySurrender as e:
@@ -1422,6 +1538,8 @@ class ParallaxSession:
             "prefetch_depth": cfg.prefetch_depth,
             "eager_fetch": cfg.eager_fetch,
             "monitor_health": cfg.monitor_health,
+            "numerics_interval": cfg.numerics_interval,
+            "numerics_drift_interval": cfg.numerics_drift_interval,
             "flight_dir": cfg.flight_dir,
             "flight_steps": cfg.flight_steps,
             "anomaly": _dc.asdict(cfg.anomaly_config),
@@ -1766,6 +1884,11 @@ class ParallaxSession:
                     parallax_log.warning("health at close: %s", report)
             except Exception as e:
                 parallax_log.warning("health drain failed: %s", e)
+        if self.numerics is not None:
+            try:
+                self.numerics.poll(block=True)
+            except Exception as e:
+                parallax_log.warning("numerics drain failed: %s", e)
         if self._metrics_sink is not None:
             try:
                 self._metrics_sink.stop()  # writes the final JSONL line

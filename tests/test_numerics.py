@@ -1,0 +1,419 @@
+"""Numerics observatory (ISSUE 17): per-layer tree stats
+(hand-computed values, in-graph sampling gate, forced-on-trip),
+NumericsMonitor lazy consumption + killswitch, NaN provenance naming a
+deliberately poisoned stage, drift sentinels (clean silent / perturbed
+flagged / margin-aware argmax flips), the anomaly-fed instability
+score, the Prometheus scrape surface, and the session end-to-end
+incident path (gauges, flight section, rollback artifact forensics)."""
+
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import parallax_tpu as parallax
+from parallax_tpu import obs
+from parallax_tpu.models import simple
+from parallax_tpu.obs import numwatch
+from parallax_tpu.obs.export import render_prometheus
+from parallax_tpu.obs.health import HealthMonitor
+from parallax_tpu.obs.metrics import MetricsRegistry
+from parallax_tpu.obs.numwatch import (SAMPLED_KEY, STAT_NAMES,
+                                       DriftSentinel, NumericsMonitor,
+                                       provenance_report, step_numerics,
+                                       stat_prefixes, tree_prefix_stats)
+
+
+def _fixture_trees():
+    """One-layer fixture with every stat hand-computable.
+
+    grads [0.001, -2.0]: absmax 2.0, so the bf16 accumulation-swallow
+    threshold is 2**-8 * 2.0 = 0.0078125 — entry 0.001 is under it,
+    entry -2.0 is not -> underflow_frac exactly 0.5."""
+    pb = {"w": jnp.array([3.0, 4.0], jnp.float32)}
+    grads = {"w": jnp.array([0.001, -2.0], jnp.float32)}
+    pa = {"w": pb["w"] - 0.1 * grads["w"]}
+    return pb, pa, grads
+
+
+class TestTreeStats:
+    def test_hand_computed_values(self):
+        pb, pa, grads = _fixture_trees()
+        stats = tree_prefix_stats(pb, pa, grads)
+        assert set(stats) == {"w"}
+        s = {k: float(v) for k, v in stats["w"].items()}
+        assert set(s) == set(STAT_NAMES)
+        assert s["grad_norm"] == pytest.approx(
+            math.sqrt(0.001 ** 2 + 4.0), rel=1e-6)
+        assert s["grad_absmax"] == 2.0
+        assert s["nonfinite"] == 0.0
+        assert s["underflow_frac"] == 0.5
+        assert s["param_norm"] == pytest.approx(5.0, rel=1e-6)
+        # update = -0.1 * grads -> ratio = 0.1*||g|| / ||w||
+        assert s["update_ratio"] == pytest.approx(
+            0.1 * math.sqrt(0.001 ** 2 + 4.0) / 5.0, rel=1e-5)
+
+    def test_nonfinite_counted_and_excluded_from_underflow(self):
+        pb = {"w": jnp.array([1.0, 1.0, 1.0], jnp.float32)}
+        grads = {"w": jnp.array([np.nan, np.inf, 0.5], jnp.float32)}
+        pa = pb
+        s = {k: float(v)
+             for k, v in tree_prefix_stats(pb, pa, grads)["w"].items()}
+        assert s["nonfinite"] == 2.0
+        assert s["underflow_frac"] == 0.0  # no finite entry is tiny
+        assert s["update_ratio"] == 0.0    # params did not move
+
+    def test_multi_layer_prefixes_skip_integer_leaves(self):
+        pb = {"enc": {"w": jnp.ones((2, 2)), "b": jnp.ones(2)},
+              "step": jnp.array(3, jnp.int32)}
+        grads = {"enc": {"w": jnp.ones((2, 2)), "b": jnp.ones(2)},
+                 "step": jnp.array(0, jnp.int32)}
+        stats = tree_prefix_stats(pb, pb, grads)
+        assert set(stats) == {"enc"}
+        assert stat_prefixes(pb) == ["enc"]
+        # enc groups BOTH leaves: ||ones(2,2)|| + ||ones(2)|| combined
+        assert float(stats["enc"]["grad_norm"]) == pytest.approx(
+            math.sqrt(6.0), rel=1e-6)
+
+
+class TestStepNumerics:
+    def test_sampling_gate_and_flag(self):
+        pb, pa, grads = _fixture_trees()
+        on = step_numerics(pb, pa, grads, step=4, interval=2)
+        off = step_numerics(pb, pa, grads, step=5, interval=2)
+        assert float(on[SAMPLED_KEY]) == 1.0
+        assert float(on["w"]["grad_absmax"]) == 2.0
+        assert float(off[SAMPLED_KEY]) == 0.0
+        assert float(off["w"]["grad_absmax"]) == 0.0
+        # both branches ship the SAME structure (AOT output contract)
+        assert set(on) == set(off)
+        assert set(on["w"]) == set(off["w"]) == set(STAT_NAMES)
+
+    def test_force_overrides_off_step(self):
+        """The trip step always carries real stats: force=True on an
+        off-interval step computes anyway — the free instrumented
+        replay provenance relies on."""
+        pb, pa, grads = _fixture_trees()
+        out = step_numerics(pb, pa, grads, step=5, interval=2,
+                            force=jnp.bool_(True))
+        assert float(out[SAMPLED_KEY]) == 1.0
+        assert float(out["w"]["underflow_frac"]) == 0.5
+
+    def test_interval_validated(self):
+        pb, pa, grads = _fixture_trees()
+        with pytest.raises(ValueError, match="interval"):
+            step_numerics(pb, pa, grads, step=0, interval=0)
+
+
+class TestNumericsMonitor:
+    def _stats(self, sampled, absmax=2.0):
+        t = {SAMPLED_KEY: np.float32(sampled)}
+        t["w"] = {s: np.float32(0.0) for s in STAT_NAMES}
+        t["w"]["grad_absmax"] = np.float32(absmax)
+        return t
+
+    def test_consume_skip_and_gauges(self):
+        reg = MetricsRegistry()
+        mon = NumericsMonitor(reg, interval=2)
+        mon.observe(0, self._stats(1.0, absmax=2.0))
+        mon.observe(1, self._stats(0.0))
+        mon.observe(2, self._stats(1.0, absmax=3.0))
+        mon.poll(block=True)
+        assert mon.total_samples == 2
+        assert mon.total_skipped == 1
+        assert reg.gauge("numerics.w.grad_absmax").value == 3.0
+        assert reg.counter("numerics.samples").value == 2
+        trail = mon.trail()
+        assert [r["step"] for r in trail] == [0, 2]
+        rep = mon.report()
+        assert rep["samples"] == 2 and rep["last_step"] == 2
+
+    def test_trail_bounded(self):
+        mon = NumericsMonitor(MetricsRegistry(), interval=1,
+                              trail_capacity=4)
+        for i in range(10):
+            mon.observe(i, self._stats(1.0))
+        mon.poll(block=True)
+        assert [r["step"] for r in mon.trail()] == [6, 7, 8, 9]
+
+    def test_killswitch_collects_nothing(self):
+        reg = MetricsRegistry()
+        mon = NumericsMonitor(reg, interval=1)
+        obs.disable()
+        try:
+            mon.observe(0, self._stats(1.0))
+            mon.poll(block=True)
+        finally:
+            obs.enable()
+        assert mon.total_samples == 0 and mon.total_skipped == 0
+        assert mon.trail() == []
+
+    def test_anomaly_feed_bounded_class_counters(self):
+        """Consumed samples feed the anomaly detector per layer; a
+        firing lands in the bounded-cardinality per-CLASS counters the
+        scrape surface exposes (anomaly.events.*), not only in the
+        exploding per-signal names."""
+        reg = MetricsRegistry()
+        anom = obs.AnomalyMonitor(reg)
+        mon = NumericsMonitor(reg, interval=1, anomaly=anom)
+        base = self._stats(1.0)
+        base["w"] = dict(base["w"], update_ratio=np.float32(0.01))
+        for i in range(20):  # past min_samples: detector armed
+            mon.observe(i, dict(base))
+        spike = dict(base)
+        spike["w"] = dict(base["w"], update_ratio=np.float32(50.0))
+        mon.observe(20, spike)
+        mon.poll(block=True)
+        assert reg.counter("anomaly.events.spike").value >= 1
+        assert reg.counter("anomaly.events.total").value >= 1
+
+
+class TestProvenance:
+    def test_poisoned_param_named_exactly(self):
+        params = {"w": jnp.array([np.nan, 1.0], jnp.float32),
+                  "b": jnp.array([1.0], jnp.float32)}
+        rep = provenance_report(params=params, loss=jnp.float32(np.nan),
+                                step=7, kind="nonfinite_loss")
+        assert rep["culprit"] == "param/w"
+        assert rep["blast_radius"] == 2  # param/w + loss
+        names = [c["name"] for c in rep["checks"]]
+        assert names == ["param/b", "param/w", "loss"]
+
+    def test_poisoned_feed_beats_params_in_dataflow_order(self):
+        feeds = {"x": np.array([np.inf, 0.0], np.float32),
+                 "y": np.array([0.0], np.float32)}
+        params = {"w": jnp.array([np.nan], jnp.float32)}
+        rep = provenance_report(feeds=feeds, params=params,
+                                loss=jnp.float32(np.nan))
+        assert rep["culprit"] == "feed/x"
+        assert rep["blast_radius"] == 3
+
+    def test_trip_stats_grad_stage(self):
+        trip = {SAMPLED_KEY: np.float32(1.0),
+                "w": {s: np.float32(0.0) for s in STAT_NAMES}}
+        trip["w"]["nonfinite"] = np.float32(4.0)
+        rep = provenance_report(trip_stats=trip, loss=jnp.float32(1.0))
+        assert rep["culprit"] == "grad/w"
+        assert rep["trip_stats_sampled"] is True
+
+    def test_unsampled_trip_stats_skipped(self):
+        trip = {SAMPLED_KEY: np.float32(0.0),
+                "w": {s: np.float32(0.0) for s in STAT_NAMES}}
+        rep = provenance_report(trip_stats=trip,
+                                loss=jnp.float32(np.nan))
+        assert rep["trip_stats_sampled"] is False
+        assert rep["culprit"] == "loss"
+
+
+class TestDriftSentinels:
+    def test_custom_pair_clean_and_drifted(self):
+        reg = MetricsRegistry()
+        ref = np.linspace(0.0, 1.0, 16, dtype=np.float32)
+        clean = DriftSentinel("toy", lambda: (ref, ref),
+                              registry=reg, rel_err_tol=1e-3)
+        r = clean.check()
+        assert not r["flagged"] and r["rel_err"] == 0.0
+        assert r["accuracy"] == 1.0
+        assert reg.gauge("numerics.drift.toy.rel_err").value == 0.0
+        drifted = DriftSentinel("toy2", lambda: (ref * 1.1, ref),
+                                registry=reg, rel_err_tol=1e-3)
+        assert drifted.check()["flagged"]
+        assert reg.counter("numerics.drift.toy2.alerts").value == 1
+
+    def test_argmax_flips_respect_tie_margin(self):
+        """A near-tie flip (top-2 margin below argmax_margin) must NOT
+        count — interpreter-vs-kernel reduction-order noise flips
+        exact ties, and a sentinel that flaps on ties is useless."""
+        ref = np.array([[0.0, 1.0, 0.5],        # clear winner: idx 1
+                        [0.0, 0.50001, 0.5]],   # near-tie: 1 vs 2
+                       np.float32)
+        cand = ref.copy()
+        cand[1, 2] = 0.51  # flips the near-tie row only
+        s = DriftSentinel("tie", lambda: (cand, ref),
+                          rel_err_tol=1e9, argmax_axis=-1,
+                          argmax_margin=1e-3)
+        assert s.check()["argmax_flip_frac"] == 0.0
+        cand2 = ref.copy()
+        cand2[0, 2] = 2.0  # flips the CLEAR row — a real flip
+        s2 = DriftSentinel("flip", lambda: (cand2, ref),
+                           rel_err_tol=1e9, argmax_axis=-1,
+                           argmax_margin=1e-3)
+        assert s2.check()["argmax_flip_frac"] == pytest.approx(0.5)
+
+    @pytest.mark.slow
+    def test_builtin_pairs_clean_silent_perturbed_flagged(self):
+        """ISSUE 17 acceptance: the real executor A/Bs (pallas LSTM
+        bwd kernel-vs-scan, paged-attn kernel-vs-einsum) stay silent
+        clean and flag a deliberately perturbed candidate."""
+        for s in numwatch.default_sentinels():
+            assert not s.check()["flagged"], s.name
+        for s in numwatch.default_sentinels(perturb=0.05):
+            assert s.check()["flagged"], s.name
+
+
+class TestInstabilityScore:
+    def test_events_raise_decay_lowers(self):
+        reg = MetricsRegistry()
+        hm = HealthMonitor(reg)
+        assert hm.instability == 0.0
+        hm.record_instability_event(0.5)
+        one = hm.instability
+        assert 0.0 < one < 1.0
+        hm.record_instability_event(0.5)
+        assert one < hm.instability < 1.0  # saturating, never >= 1
+        assert reg.snapshot()["health.instability"] == pytest.approx(
+            hm.instability, abs=1e-6)
+
+    def test_scrape_surface_carries_numerics_telemetry(self):
+        """obs/export.py: the per-class anomaly counters and the
+        instability gauge come out of render_prometheus as well-formed
+        series — the fleet dashboard sees the numerics observatory."""
+        reg = MetricsRegistry()
+        hm = HealthMonitor(reg)
+        hm.record_instability_event(1.0)
+        reg.counter("anomaly.events.spike").inc(3)
+        reg.counter("anomaly.events.total").inc(3)
+        text = render_prometheus({"replica0": reg.snapshot()})
+        assert "parallax_anomaly_events_total" in text
+        assert "parallax_anomaly_events_spike" in text
+        assert "parallax_health_instability" in text
+
+
+# -- session end to end ----------------------------------------------------
+
+
+def _session(**cfg_kw):
+    sess, *_ = parallax.parallel_run(
+        simple.build_model(learning_rate=0.1),
+        parallax_config=parallax.Config(run_option="AR",
+                                        search_partitions=False,
+                                        **cfg_kw))
+    return sess
+
+
+def _batch(i, nan=False):
+    b = simple.make_batch(np.random.default_rng(100 + i), 32)
+    if nan:
+        b["x"] = b["x"] * np.nan
+    return b
+
+
+class TestSessionNumerics:
+    def test_sampled_gauges_trail_and_flight_section(self, tmp_path):
+        sess = _session(numerics_interval=2)
+        try:
+            for i in range(6):
+                sess.run("loss", feed_dict=_batch(i))
+            sess.numerics.poll(block=True)
+            assert sess.numerics.total_samples == 3   # steps 0,2,4
+            assert sess.numerics.total_skipped == 3
+            snap = sess.metrics_snapshot()
+            for stat in STAT_NAMES:
+                assert f"numerics.w.{stat}" in snap
+                assert f"numerics.b.{stat}" in snap
+            path = sess.dump_flight(str(tmp_path / "f.json"))
+            with open(path) as f:
+                doc = json.load(f)
+            num = doc["numerics"]
+            assert num["samples"] == 3
+            assert len(num["trail"]) == 3
+        finally:
+            sess.close()
+
+    def test_nonfinite_rollback_artifact_names_poisoned_feed(
+            self, tmp_path):
+        """The incident path: a NaN batch trips recovery; the rollback
+        artifact must NAME feed/x as the culprit and carry the stats
+        trail — with numerics_interval=2 and the trip on an ODD step,
+        only the forced-on-trip sample makes that possible."""
+        fdir = str(tmp_path / "fl")
+        sess = _session(
+            numerics_interval=2, flight_dir=fdir,
+            recovery_config=parallax.RecoveryConfig(
+                enabled=True, snapshot_every_steps=2, max_retries=2))
+        try:
+            for i in range(8):
+                sess.run("loss", feed_dict=_batch(i, nan=(i == 5)))
+            arts = [p for p in os.listdir(fdir)
+                    if p.startswith("flight_nonfinite_rollback_")]
+            assert arts, os.listdir(fdir)
+            with open(os.path.join(fdir, arts[0])) as f:
+                doc = json.load(f)
+            det = ((doc.get("trigger") or {}).get("detail")
+                   or doc.get("detail") or {})
+            prov = det["provenance"]
+            assert prov["culprit"] == "feed/x"
+            assert prov["trip_stats_sampled"] is True
+            assert len(det["stats_trail"]) >= 1
+            # the incident fed the instability score
+            assert sess.health.instability > 0.0
+        finally:
+            sess.close()
+
+    def test_structural_killswitch(self):
+        """Under obs.disable() the session builds NO monitor and the
+        engine adds NO in-graph output — zero cost, not cheap cost."""
+        obs.disable()
+        try:
+            sess = _session(numerics_interval=1)
+            try:
+                assert sess.numerics is None
+                sess.run("loss", feed_dict=_batch(0))
+                assert "numerics" not in (sess._last_outputs or {})
+            finally:
+                sess.close()
+        finally:
+            obs.enable()
+
+    def test_reserved_output_name_rejected(self):
+        import jax
+        import optax
+        from parallax_tpu.core.engine import Model
+
+        def init_fn(rng):
+            return {"w": jax.random.normal(rng, (1,))}
+
+        def loss_fn(params, batch):
+            loss = jnp.mean((params["w"] * batch["x"]
+                             - batch["y"]) ** 2)
+            return loss, {"numerics": loss}  # collides with the hook
+
+        model = Model(init_fn, loss_fn, optimizer=optax.sgd(0.1))
+        sess = None
+        with pytest.raises(ValueError, match="numerics"):
+            res = parallax.parallel_run(
+                model, parallax_config=parallax.Config(
+                    run_option="AR", search_partitions=False,
+                    numerics_interval=2))
+            sess = res[0] if isinstance(res, tuple) else res
+            sess.run("loss", feed_dict=_batch(0))
+        if sess is not None:
+            sess.close()
+
+    def test_drift_sweep_on_demand(self):
+        sess = _session(numerics_interval=2)
+        try:
+            results = sess.run_drift_sentinels()
+            assert {r["name"] for r in results} == {"lstm_bwd",
+                                                    "paged_attn"}
+            assert not any(r["flagged"] for r in results)
+            snap = sess.metrics_snapshot()
+            assert snap["numerics.drift.lstm_bwd.accuracy"] >= 0.999
+            assert snap["numerics.drift.paged_attn.accuracy"] >= 0.99
+        finally:
+            sess.close()
+
+
+class TestConfigValidation:
+    def test_negative_intervals_rejected(self):
+        with pytest.raises(ValueError):
+            parallax.Config(numerics_interval=-1)
+        with pytest.raises(ValueError):
+            parallax.Config(numerics_drift_interval=-2)
+
+    def test_numerics_auto_enables_health(self):
+        cfg = parallax.Config(numerics_interval=4)
+        assert cfg.monitor_health is True

@@ -640,6 +640,13 @@ def test_obs_overhead_within_budget():
     assert last["timeline_rows_per_step"] >= 1, last
     assert last["anomaly_obs_per_step"] >= 1, last
     assert last["killswitch_clean"], last
+    # the numerics observatory (ISSUE 17) rides inside the same
+    # budget: sampled at interval=4 on the rig, its on- and off-step
+    # consume costs are both priced in, and the killswitch leaves no
+    # monitor, no in-graph output, no collection
+    assert last["numerics_samples_per_step"] == pytest.approx(0.25), last
+    assert last["unit_costs_us"]["numerics_consume"] > 0, last
+    assert last["numerics_killswitch_clean"], last
 
 
 def test_serve_obs_overhead_within_budget():

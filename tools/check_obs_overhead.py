@@ -1,11 +1,17 @@
 """Micro-bench: the observability layer must cost <=2% of step wall-time.
 
-ISSUE 2 acceptance (extended by ISSUEs 5 and 13): the always-on
+ISSUE 2 acceptance (extended by ISSUEs 5, 13 and 17): the always-on
 instrumentation — spans + metrics registry, the per-step timeline
 attribution row, the step-time anomaly detector, the plan
-observatory's per-step memwatch sample and idle profile-hook bracket
+observatory's per-step memwatch sample and idle profile-hook bracket,
+and the numerics observatory at its default sampling duty cycle (one
+consume per sampled step + one skip per off-step)
 — on the simple-model step loop stays within 2% of the
-uninstrumented loop. The flight
+uninstrumented loop. ISSUE 17's killswitch claim is STRUCTURAL and
+asserted on a second mini-session built under ``obs.disable()``:
+``PARALLAX_OBS=0`` means zero extra step outputs (no ``numerics`` key
+in the output dict at all) and no consumer/replay machinery
+constructed (``sess.numerics is None``). The flight
 recorder does NO per-step work (it dumps bounded rings other
 components already fill), so it has no term here; what is asserted for
 it (and the rest) is the kill switch: with ``obs.disable()`` the
@@ -71,10 +77,16 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
     from parallax_tpu.obs import trace
     from parallax_tpu.models import simple
 
+    # numerics_interval=4 puts the ISSUE-17 observatory on the priced
+    # rig at its documented default-sampling duty cycle (every 4th
+    # step pays one in-graph stats tree + one host consume); the
+    # auto-enabled monitor_health rides along and is counted by the
+    # same span/hist/inc accounting as everything else
     sess, *_ = parallax.parallel_run(
         simple.build_model(learning_rate=0.1),
         parallax_config=parallax.Config(run_option="AR",
-                                        search_partitions=False))
+                                        search_partitions=False,
+                                        numerics_interval=4))
     rng = np.random.default_rng(0)
     batches = [simple.make_batch(rng, batch) for _ in range(8)]
     try:
@@ -87,6 +99,8 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
         before = sess.metrics.snapshot()
         tl_before = sess.timeline.total_rows
         anom_before = sess.anomaly.total_observed
+        nm_before = sess.numerics.total_samples \
+            + sess.numerics.total_skipped
         obs.enable()
         times = []
         last = None
@@ -95,7 +109,12 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
             last = sess.run("loss", feed_dict=batches[i % 8])
             times.append(time.perf_counter() - t0)
         float(last)  # drain
+        sess.numerics.poll(block=True)  # consume every queued sample
         after = sess.metrics.snapshot()
+        nm_consumed_per_step = (sess.numerics.total_samples
+                                + sess.numerics.total_skipped
+                                - nm_before) / steps
+        nm_samples_per_step = 1.0 / sess.numerics.interval
         spans_per_step = len(collector.events()) / steps
         tl_rows_per_step = (sess.timeline.total_rows - tl_before) / steps
         anom_per_step = (sess.anomaly.total_observed
@@ -156,11 +175,39 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
         ph_bench = ProfileHook(None, 0)
         ph_us = _unit_cost_us(lambda: (ph_bench.before_step(0),
                                        ph_bench.after_step(0)))
+        # numerics observatory (ISSUE 17): one FULL consume per
+        # sampled step (gauge sets + trail append + anomaly feeds,
+        # priced against already-host numpy values so the unit cost is
+        # the host work, not a device sync) plus one skip-path consume
+        # per off-step. The anomaly observations the consume fires are
+        # ALSO counted in anom_per_step above — double-priced, i.e.
+        # conservative.
+        from parallax_tpu.obs import numwatch
+        nm_bench = numwatch.NumericsMonitor(obs.MetricsRegistry(),
+                                            interval=1)
+        fake_on = {numwatch.SAMPLED_KEY: np.float32(1.0)}
+        fake_off = {numwatch.SAMPLED_KEY: np.float32(0.0)}
+        for layer in ("w", "b"):
+            fake_on[layer] = {s: np.float32(0.1)
+                              for s in numwatch.STAT_NAMES}
+            fake_off[layer] = {s: np.float32(0.0)
+                               for s in numwatch.STAT_NAMES}
+        nm_state = {"i": 0}
+
+        def one_numerics_consume():
+            nm_bench.observe(nm_state["i"], fake_on)
+            nm_state["i"] += 1
+
+        nm_us = _unit_cost_us(one_numerics_consume)
+        nm_skip_us = _unit_cost_us(
+            lambda: nm_bench.observe(0, fake_off))
 
         obs_us = (spans_per_step * span_us + hist_per_step * hist_us
                   + incs_per_step * inc_us + sig_us
                   + tl_rows_per_step * tl_us + anom_per_step * anom_us
-                  + mw_us + ph_us)
+                  + mw_us + ph_us
+                  + nm_samples_per_step * nm_us
+                  + (1.0 - nm_samples_per_step) * nm_skip_us)
         overhead_frac = obs_us / step_us
 
         # kill switch: disabled, the forensics layer must not collect
@@ -187,6 +234,30 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
                                 and am_bench.total_observed == n_am)
             memwatch_killswitch_clean = (mw_ring.total_samples
                                          == n_mw == 1)
+            # numerics killswitch is STRUCTURAL (ISSUE 17): disabled,
+            # the monitor must not even queue a sample...
+            n_nm = nm_bench.total_samples + nm_bench.total_skipped
+            nm_bench.observe(0, fake_on)
+            numerics_monitor_clean = (
+                nm_bench.total_samples + nm_bench.total_skipped == n_nm)
+            # ...and a session BUILT disabled must construct no
+            # consumer / replay machinery and append zero extra step
+            # outputs — the engine's build-time gate, checked on the
+            # real output dict of a fresh mini-session
+            sess2, *_ = parallax.parallel_run(
+                simple.build_model(learning_rate=0.1),
+                parallax_config=parallax.Config(
+                    run_option="AR", search_partitions=False,
+                    numerics_interval=1))
+            try:
+                out2 = sess2.run(None, feed_dict=batches[0])
+                numerics_killswitch_clean = (
+                    numerics_monitor_clean
+                    and sess2.numerics is None
+                    and sess2._numerics_last_batch is None
+                    and "numerics" not in out2)
+            finally:
+                sess2.close()
         finally:
             obs.enable()
 
@@ -220,6 +291,9 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
             "counter_incs_per_step": round(incs_per_step, 2),
             "timeline_rows_per_step": round(tl_rows_per_step, 2),
             "anomaly_obs_per_step": round(anom_per_step, 2),
+            "numerics_samples_per_step": round(nm_samples_per_step, 3),
+            "numerics_consumed_per_step": round(nm_consumed_per_step,
+                                                3),
             "unit_costs_us": {"span": round(span_us, 3),
                               "histogram_record": round(hist_us, 3),
                               "counter_inc": round(inc_us, 3),
@@ -227,9 +301,12 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
                               "timeline_row": round(tl_us, 3),
                               "anomaly_observe": round(anom_us, 3),
                               "memwatch_sample": round(mw_us, 3),
-                              "profile_hook_idle": round(ph_us, 3)},
+                              "profile_hook_idle": round(ph_us, 3),
+                              "numerics_consume": round(nm_us, 3),
+                              "numerics_skip": round(nm_skip_us, 3)},
             "killswitch_clean": killswitch_clean,
             "memwatch_killswitch_clean": memwatch_killswitch_clean,
+            "numerics_killswitch_clean": numerics_killswitch_clean,
             "ab_overhead_frac": round(ab, 4),
         }
     finally:
@@ -352,7 +429,8 @@ def main(argv=None) -> int:
     result["max_overhead"] = args.max_overhead
     result["ok"] = (result["overhead_frac"] <= args.max_overhead
                     and result["killswitch_clean"]
-                    and result["memwatch_killswitch_clean"])
+                    and result["memwatch_killswitch_clean"]
+                    and result["numerics_killswitch_clean"])
     if not args.skip_serve:
         result["serve"] = measure_serve()
         result["ok"] = (result["ok"]

@@ -270,6 +270,20 @@ SECONDARY_GATES = (
     ("attn.step_ms.kernel", False),
     ("attn.kernel_over_einsum", False),
     ("attn.kernel_over_einsum", True),
+    # numerics observatory (ISSUE 17, bench "numerics" block from
+    # tools/numerics_report.py): each drift sentinel's accuracy
+    # (1/(1+rel_err), ~1.0 when candidate and reference executors
+    # agree) is gated in BOTH directions — the two-row two-sided
+    # drift pattern: a FALLING accuracy means a kernel started
+    # disagreeing with its reference (the regression the sentinels
+    # exist to catch), a rising one means the reference moved; and
+    # the host-side per-sample consume cost must not creep (it is
+    # priced into the <=2% obs budget by check_obs_overhead)
+    ("numerics.drift.lstm_bwd.accuracy", False),
+    ("numerics.drift.lstm_bwd.accuracy", True),
+    ("numerics.drift.paged_attn.accuracy", False),
+    ("numerics.drift.paged_attn.accuracy", True),
+    ("numerics.consume_us", False),
 )
 
 

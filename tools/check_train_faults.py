@@ -88,7 +88,11 @@ def child_main(args) -> int:
             save_ckpt_steps=CKPT_EVERY if args.ckpt_dir else None),
         recovery_config=parallax.RecoveryConfig(
             enabled=bool(args.recovery), snapshot_every_steps=2,
-            max_retries=2))
+            max_retries=2),
+        # numerics provenance only on the recovery phases: the
+        # sigkill/torn phases compare losses bit-exactly against the
+        # uninstrumented baseline, so their graphs must stay identical
+        numerics_interval=2 if args.recovery else 0)
     sess, *_ = parallax.parallel_run(simple.build_model(0.1),
                                      parallax_config=cfg)
     start = sess.prepare(_batch_for(0))
@@ -269,6 +273,25 @@ def measure(steps: int = STEPS) -> dict:
     if r3["losses"]:
         last = float.fromhex(r3["losses"][max(r3["losses"])])
         finite_final = last == last and abs(last) != float("inf")
+    # NaN provenance: the rollback artifact must NAME the poisoned
+    # stage (feed/x — the injected batch), not just record the trip
+    provenance = {"culprit": None, "trail_len": 0, "blast_radius": None}
+    try:
+        arts = sorted(p for p in os.listdir(fl3)
+                      if p.startswith("flight_nonfinite_rollback_"))
+        if arts:
+            with open(os.path.join(fl3, arts[0])) as f:
+                doc = json.load(f)
+            det = ((doc.get("trigger") or {}).get("detail")
+                   or doc.get("detail") or {})
+            prov = det.get("provenance") or {}
+            provenance = {
+                "culprit": prov.get("culprit"),
+                "blast_radius": prov.get("blast_radius"),
+                "trail_len": len(det.get("stats_trail") or ()),
+            }
+    except (OSError, ValueError):
+        pass
     result["nan"] = {
         "rc": p3.returncode,
         "seconds": round(time.perf_counter() - t0, 3),
@@ -277,6 +300,7 @@ def measure(steps: int = STEPS) -> dict:
         "recorded": len(r3["losses"]),
         "final_loss_finite": finite_final,
         "flight_classes": _flight_classes(fl3),
+        "provenance": provenance,
     }
     # poisoned run: every batch NaN -> bounded surrender, nonzero rc
     fl3b = os.path.join(work, "fl_nan_all")
@@ -395,6 +419,12 @@ def check(result: dict) -> list:
     if "nonfinite_rollback" not in n["flight_classes"]:
         bad.append(f"no `nonfinite_rollback` flight artifact (got "
                    f"{n['flight_classes']})")
+    if n["provenance"]["culprit"] != "feed/x":
+        bad.append(f"provenance did not name the poisoned feed "
+                   f"(culprit={n['provenance']['culprit']!r}, "
+                   f"expected 'feed/x')")
+    if n["provenance"]["trail_len"] < 1:
+        bad.append("rollback artifact carries no numerics stats trail")
     if not n["surrendered"]:
         bad.append(f"all-NaN run did not surrender within the retry "
                    f"budget (rc={n['surrender_rc']})")
