@@ -292,6 +292,12 @@ class TuneConfig:
       (default: AR, SHARD and HYBRID; legacy MPI/PS aliases accepted).
     * ``min_tp`` / ``max_tp``: bounds on the shard-axis width
       candidates (divisors of the device count within the range).
+    * ``max_pp``: cap on the pipeline-stage axis (ISSUE 18). The
+      default 1 keeps the search exactly 2-D; ``max_pp > 1`` admits
+      ``pp > 1`` plans — but only for models that declare
+      ``Model.pipeline_info`` (the schedule, microbatch count and
+      layer stack the stages would split), so the knob is inert on
+      non-pipeline models.
     * ``trial_steps`` / ``trial_warmup``: steps per measured trial;
       the MEDIAN over steps ``[trial_warmup, trial_steps)`` is the
       trial's time (robust to a single host stall inside the short
@@ -319,6 +325,7 @@ class TuneConfig:
     run_options: Optional[Sequence[str]] = None
     min_tp: int = 1
     max_tp: Optional[int] = None
+    max_pp: int = 1
     trial_steps: int = 12
     trial_warmup: int = 4
     peak_flops: Optional[float] = None
@@ -347,6 +354,9 @@ class TuneConfig:
             raise ValueError(
                 f"tune max_tp ({self.max_tp}) must be >= min_tp "
                 f"({self.min_tp})")
+        if int(self.max_pp) < 1:
+            raise ValueError(
+                f"tune max_pp must be >= 1, got {self.max_pp}")
         if int(self.trial_warmup) < 0:
             raise ValueError(
                 f"tune trial_warmup must be >= 0, got "

@@ -86,9 +86,19 @@ class Model:
                  batch_specs: Optional[Dict[str, Any]] = None,
                  param_specs: Optional[Dict[str, Any]] = None,
                  slice_updaters: Optional[Dict[str, Any]] = None,
-                 value_and_grad_fn: Optional[Callable] = None):
+                 value_and_grad_fn: Optional[Callable] = None,
+                 pipeline_info: Optional[Dict[str, Any]] = None):
         self.init_fn = init_fn
         self.loss_fn = loss_fn
+        # Pipeline capability record (ISSUE 18): a model that can run
+        # its layer stack through ops/pipeline declares the schedule
+        # here ({"schedule", "microbatches", "virtual_stages",
+        # "pinned_stages", "num_layers", "model_dim", "act_itemsize",
+        # optional "layer_costs"}). The tuner reads it via
+        # costmodel.inputs_from_engine to admit and price pp>1 plans;
+        # None (default) keeps the search strictly 2-D for this model.
+        self.pipeline_info = (dict(pipeline_info)
+                              if pipeline_info else None)
         # Optional fused loss+gradient override:
         # ``value_and_grad_fn(params, batch, rng) ->
         # (loss, metrics, grads)``. For models whose backward schedule
@@ -244,6 +254,11 @@ def build_plan(model: Model, mesh: Mesh, config: ParallaxConfig,
     def with_override(path, leaf, spec):
         for pattern, override in model.param_specs.items():
             if fnmatch.fnmatch(path, pattern):
+                # 'pipe' resolves to 'shard' on meshes without a pipe
+                # axis (core/mesh.resolve_spec): a model declares
+                # stage-sharded variables ONCE and runs on both the
+                # legacy 2-axis mesh and a (dp, tp, pp) mesh
+                override = mesh_lib.resolve_spec(override, mesh)
                 bad = spec_shape_mismatch(override, leaf.shape, mesh)
                 if bad is not None:
                     dim, axes, size = bad
@@ -284,6 +299,33 @@ def build_plan(model: Model, mesh: Mesh, config: ParallaxConfig,
     return plan
 
 
+_pipeline_cache_guarded = False
+
+
+def _guard_persistent_cache_for_pipeline():
+    """Deserializing a persistently-cached pipeline-schedule executable
+    (ops/pipeline ppermute schedules, custom value_and_grad) segfaults
+    this XLA:CPU toolchain — a hard process kill, not an exception the
+    caller could catch. The first pipeline engine built in a process
+    therefore switches the persistent compilation cache off, BEFORE
+    its first cache lookup: stale on-disk entries become unreachable
+    as well as unwritable, and every executable compiled earlier in
+    the process keeps its cached copy."""
+    global _pipeline_cache_guarded
+    if _pipeline_cache_guarded:
+        return
+    _pipeline_cache_guarded = True
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            jax.config.update("jax_compilation_cache_dir", None)
+            parallax_log.warning(
+                "pipeline engine: persistent XLA compilation cache "
+                "disabled for this process — cached pipeline-schedule "
+                "executables crash on reload with this toolchain")
+    except Exception:
+        pass
+
+
 class Engine:
     """Builds and owns the compiled init/step executables for one mesh."""
 
@@ -293,6 +335,9 @@ class Engine:
         self.model = model
         self.mesh = mesh
         self.config = config
+        if (model.pipeline_info is not None
+                or model.value_and_grad_fn is not None):
+            _guard_persistent_cache_for_pipeline()
         # observability (obs/): the owning session passes its registry;
         # direct Engine construction (tools/, tests) gets a private one
         self.metrics = metrics if metrics is not None \
@@ -792,6 +837,7 @@ class Engine:
         accept real placed batches."""
         spec = self.model.batch_specs.get(name)
         if spec is not None:
+            spec = mesh_lib.resolve_spec(spec, self.mesh)
             return NamedSharding(self.mesh, spec)
         return self.batch_sharding_fn(ndim)
 
@@ -806,6 +852,7 @@ class Engine:
         spec = self.model.batch_specs.get(name)
         if spec is None:
             return jax.process_count()
+        spec = mesh_lib.resolve_spec(spec, self.mesh)
         if len(spec) == 0 or spec[0] is None:
             return 1
         axes = ((spec[0],) if isinstance(spec[0], str)
@@ -1063,7 +1110,7 @@ def place_host_batch(mesh: Mesh, batch,
         if name in transforms:
             x = np.asarray(transforms[name](x, mesh))
         if name in overrides:
-            spec = overrides[name]
+            spec = mesh_lib.resolve_spec(overrides[name], mesh)
             # in multiprocess mode the caller feeds a process-local
             # slice, so each dim's requirement shrinks by the process
             # span of its axes

@@ -35,7 +35,14 @@ from parallax_tpu.common.lib import parallax_log
 
 AXIS_REPL = "repl"
 AXIS_SHARD = "shard"
-# Spec helpers used across the engine.
+# Third mesh axis (ISSUE 18): pipeline stages. Only present on meshes
+# built from a (dp, tp, pp) plan shape with pp > 1 — every mesh a 2-D
+# plan builds stays the exact two-axis ('repl', 'shard') layout, so
+# pp=1 plans are byte-identical to the pre-PR-18 world.
+AXIS_PIPE = "pipe"
+# Spec helpers used across the engine. The batch rides (repl, shard)
+# on every mesh: pipeline stages need the full per-replica batch, so
+# 'pipe' never shards inputs.
 BATCH_AXES = (AXIS_REPL, AXIS_SHARD)
 
 
@@ -88,6 +95,12 @@ def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
     enumerates valid factorizations, so a mismatch here is a caller
     bug and raises instead of snapping.
 
+    ``shape=(dp, tp, pp)`` (ISSUE 18) grows the third axis: ``pp``
+    pipeline stages nested INSIDE each shard column, axes
+    ``('repl', 'shard', 'pipe')``. ``pp=1`` collapses to the exact
+    two-axis mesh the 2-tuple form builds — no 'pipe' axis appears, so
+    2-D plans keep their pre-PR-18 placements bit for bit.
+
     ``num_partitions`` (mutually exclusive with ``shape``) is the
     legacy 1-D knob: the shard-axis size, clamped to a divisor of the
     device count (the reference's fixed_size_partitioner accepts any
@@ -112,11 +125,25 @@ def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
             raise ValueError(
                 "build_mesh: pass shape=(dp, tp) OR num_partitions, "
                 "not both")
-        dp, p = (int(shape[0]), int(shape[1]))
-        if dp < 1 or p < 1 or dp * p != n:
+        if len(shape) not in (2, 3):
+            raise ValueError(
+                f"build_mesh shape {tuple(shape)} must be (dp, tp) or "
+                "(dp, tp, pp)")
+        dp, p = int(shape[0]), int(shape[1])
+        pp = int(shape[2]) if len(shape) == 3 else 1
+        if dp < 1 or p < 1 or pp < 1 or dp * p * pp != n:
             raise ValueError(
                 f"build_mesh shape {tuple(shape)} does not tile the "
-                f"{n} device(s); dp*tp must equal the device count")
+                f"{n} device(s); dp*tp*pp must equal the device count")
+        if pp > 1:
+            # stage ring innermost: a 1F1B ppermute hop is the
+            # shortest-distance neighbor exchange the ordering can buy
+            devices = _order_by_domain(devices, p * pp)
+            arr = np.empty((n,), dtype=object)
+            for i, d in enumerate(devices):
+                arr[i] = d
+            return Mesh(arr.reshape(dp, p, pp),
+                        (AXIS_REPL, AXIS_SHARD, AXIS_PIPE))
     else:
         p = num_partitions if num_partitions else n
         snapped = snap_to_divisor(p, n)
@@ -170,4 +197,39 @@ def num_shards(mesh: Mesh) -> int:
 
 
 def num_devices(mesh: Mesh) -> int:
-    return mesh.shape[AXIS_REPL] * mesh.shape[AXIS_SHARD]
+    return int(mesh.devices.size)
+
+
+def pipeline_axis(mesh: Mesh) -> str:
+    """The mesh axis pipeline stages ride on: the dedicated 'pipe' axis
+    when the mesh has one (a pp>1 plan), else the legacy convention of
+    stages over 'shard' (how every pre-PR-18 pipeline mesh was built,
+    and still how 2-D plans of pipeline models execute)."""
+    return AXIS_PIPE if AXIS_PIPE in mesh.axis_names else AXIS_SHARD
+
+
+def pipeline_stage_count(mesh: Mesh) -> int:
+    return mesh.shape[pipeline_axis(mesh)]
+
+
+def resolve_spec(spec: P, mesh: Mesh) -> P:
+    """Map a PartitionSpec onto the axes ``mesh`` actually has: any
+    'pipe' entry on a mesh without a pipe axis becomes 'shard' (the
+    legacy stages-over-shard placement). Model code can then declare
+    stage-sharded variables as ``P(AXIS_PIPE)`` once and run unchanged
+    on both 2-axis and 3-axis meshes. Axes the mesh knows are passed
+    through untouched (including unknown names — downstream validation
+    still owns that error)."""
+    if AXIS_PIPE in mesh.axis_names:
+        return spec
+
+    def _resolve(entry):
+        if entry == AXIS_PIPE:
+            return AXIS_SHARD
+        if isinstance(entry, (tuple, list)):
+            return tuple(_resolve(e) for e in entry)
+        return entry
+
+    if not any(_resolve(e) != e for e in spec):
+        return spec
+    return P(*(_resolve(e) for e in spec))

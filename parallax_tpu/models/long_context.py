@@ -21,7 +21,8 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from parallax_tpu.core.engine import Model
-from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD
+from parallax_tpu.core.mesh import (AXIS_PIPE, AXIS_REPL, AXIS_SHARD,
+                                    pipeline_stage_count)
 from parallax_tpu.ops import embedding as emb_ops
 from parallax_tpu.ops import tensor_parallel as tp_ops
 from parallax_tpu.ops.ring_attention import (full_attention_reference,
@@ -44,9 +45,10 @@ class LongContextConfig:
     #             kernels over 'shard' (ops/tensor_parallel.py; GSPMD
     #             inserts the psum after each row-parallel matmul),
     #             batch data-parallel over 'repl'
-    # 'pipeline': pipeline parallelism — layer stages over 'shard',
-    #             GPipe microbatch pipelining (ops/pipeline.py), batch
-    #             data-parallel over 'repl'
+    # 'pipeline': pipeline parallelism — layer stages over the mesh's
+    #             pipeline axis ('pipe' on a 3-axis (dp, tp, pp) mesh,
+    #             else 'shard'), GPipe microbatch pipelining
+    #             (ops/pipeline.py), batch data-parallel over 'repl'
     # 'data'    : pure data parallelism (attention unsharded)
     parallelism: str = "ring"
     num_microbatches: int = 4  # pipeline mode
@@ -61,7 +63,7 @@ class LongContextConfig:
     # virtual_stages non-adjacent layer chunks, cutting the pipeline
     # bubble virtual_stages-fold (ops/pipeline.py). Because the chunk
     # assignment depends on the stage count, virtual_stages > 1 requires
-    # declaring ``pipeline_stages`` (the 'shard' mesh axis size the
+    # declaring ``pipeline_stages`` (the pipeline mesh axis size the
     # model will run on); layers are then STORED in device-major stage
     # order at init so no in-graph cross-shard permute is ever needed.
     virtual_stages: int = 1
@@ -210,13 +212,13 @@ def build_model(cfg: LongContextConfig) -> Model:
         if Vp > 1 and n_stages != cfg.pipeline_stages:
             raise ValueError(
                 f"model was built for pipeline_stages="
-                f"{cfg.pipeline_stages} but the mesh shard axis is "
+                f"{cfg.pipeline_stages} but the mesh pipeline axis is "
                 f"{n_stages}")
         if cfg.num_layers % (n_stages * Vp):
             raise ValueError(
                 f"pipeline parallelism needs num_layers "
                 f"({cfg.num_layers}) divisible by the "
-                f"{n_stages}-stage shard axis (x{Vp} virtual)")
+                f"{n_stages}-stage pipeline axis (x{Vp} virtual)")
         per_stage = cfg.num_layers // (n_stages * Vp)
 
         def stage_fn(stage_params, x):
@@ -345,7 +347,7 @@ def build_model(cfg: LongContextConfig) -> Model:
         if "blocks_stacked" in params:
             from parallax_tpu.ops.pipeline import pipeline_apply
             stacked = params["blocks_stacked"]
-            n_stages = (mesh.shape[AXIS_SHARD]
+            n_stages = (pipeline_stage_count(mesh)
                         if mesh is not None else 1)
             if mesh is None or n_stages == 1:
                 # sequential fallback: apply rows in ORIGINAL layer
@@ -394,7 +396,7 @@ def build_model(cfg: LongContextConfig) -> Model:
         ids = batch["ids"]
         B, T = ids.shape
         mesh = emb_ops.current_mesh()
-        n_stages = mesh.shape[AXIS_SHARD] if mesh is not None else 1
+        n_stages = pipeline_stage_count(mesh) if mesh is not None else 1
         if mesh is None or n_stages == 1:
             (loss, metrics), grads = jax.value_and_grad(
                 lambda p: loss_fn(p, batch, rng),
@@ -450,16 +452,31 @@ def build_model(cfg: LongContextConfig) -> Model:
     tx = optax.chain(optax.clip_by_global_norm(1.0),
                      optax.adam(cfg.learning_rate))
     if cfg.parallelism == "pipeline":
-        # layer stages over 'shard' (each device owns num_layers/S
-        # layers), microbatch pipelining; batch dp over 'repl'
+        # layer stages over the mesh's pipeline axis (each device owns
+        # num_layers/S layers), microbatch pipelining; batch dp over
+        # 'repl'. The 'pipe' spec resolves to 'shard' on a 2-axis mesh
+        # (core/mesh.resolve_spec), so one declaration serves both.
+        # pipeline_info is the tuner's capability record: it unlocks the
+        # pp > 1 half of the plan space and prices its bubble and
+        # inter-stage transfers (tune/costmodel.py).
         return Model(
             init_fn, loss_fn, optimizer=tx,
             dense_params=("emb", "pos"),
             batch_specs={"ids": P(AXIS_REPL, None)},
-            param_specs={"blocks_stacked/*": P(AXIS_SHARD)},
+            param_specs={"blocks_stacked/*": P(AXIS_PIPE)},
             value_and_grad_fn=(pipeline_1f1b_vag
                                if cfg.pipeline_schedule == "1f1b"
-                               else None))
+                               else None),
+            pipeline_info={
+                "schedule": cfg.pipeline_schedule,
+                "microbatches": int(cfg.num_microbatches),
+                "virtual_stages": Vp,
+                "pinned_stages": (int(cfg.pipeline_stages)
+                                  if cfg.pipeline_stages else None),
+                "num_layers": int(cfg.num_layers),
+                "model_dim": int(D),
+                "act_itemsize": int(np.dtype(dt).itemsize),
+            })
     if cfg.parallelism == "tensor":
         # Megatron-style TP: qkv/up-proj column-parallel, out/down-proj
         # row-parallel over 'shard'; batch data-parallel over 'repl'.

@@ -1,11 +1,14 @@
 """Pipeline parallelism: GPipe-style microbatch pipelining over the mesh.
 
 Absent from the reference (SURVEY.md §2.5) and from round-1 scope until
-now: layer *stages* are sharded over the ``'shard'`` axis (stage s's
-parameters live only on device s via a stacked leading axis), and
-microbatches flow through the stage ring with one `ppermute` hop per
-tick. All devices execute the same SPMD program; a device is "active"
-for tick t iff its stage s has a microbatch in flight (0 <= t - s < M).
+now: layer *stages* are sharded over the mesh's pipeline axis — the
+dedicated ``'pipe'`` axis when the mesh was built from a 3-D
+``(dp, tp, pp)`` plan (ISSUE 18), else the legacy ``'shard'`` axis
+(stage s's parameters live only on ring position s via a stacked
+leading axis) — and microbatches flow through the stage ring with one
+`ppermute` hop per tick. All devices execute the same SPMD program; a
+device is "active" for tick t iff its stage s has a microbatch in
+flight (0 <= t - s < M).
 
 Two schedules:
 
@@ -52,14 +55,38 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from parallax_tpu.core.mesh import AXIS_REPL, AXIS_SHARD
+from parallax_tpu.core.mesh import AXIS_REPL, pipeline_axis
 from parallax_tpu.common import compat
+from parallax_tpu.common.lib import parallax_log
 
 
 def _rounded_microbatches(M: int, S: int, V: int) -> int:
     """Schedule entries per chunk: M, rounded up to whole rounds of S
     when interleaving (ragged rounds become masked bubble entries)."""
     return M if V == 1 else -(-M // S) * S
+
+
+_ragged_warned = set()
+
+
+def _warn_ragged(M: int, S: int, V: int) -> None:
+    """Warn ONCE per (M, S, V) that an interleaved schedule with a
+    ragged final round (M % S != 0) executes padded bubble entries —
+    real ticks of pure waste. The cost model prices the same rounded M
+    (tune/costmodel.py uses `_rounded_microbatches`), so the predicted
+    bubble matches what actually runs."""
+    if V == 1 or M % S == 0:
+        return
+    key = (int(M), int(S), int(V))
+    if key in _ragged_warned:
+        return
+    _ragged_warned.add(key)
+    Mr = _rounded_microbatches(M, S, V)
+    parallax_log.warning(
+        "interleaved pipeline: num_microbatches=%d is not a multiple "
+        "of num_stages=%d; the schedule pads to %d entries per chunk "
+        "(%d masked bubble entries of pure waste at V=%d). Prefer "
+        "M %% S == 0.", M, S, Mr, Mr - M, V)
 
 
 def _decode_entry(k, S: int, V: int, M: int, reverse: bool = False):
@@ -130,7 +157,8 @@ def pipeline_apply(stage_fn: Callable,
 
     Returns [B, ...] outputs (replicated over 'shard').
     """
-    S = mesh.shape[AXIS_SHARD]
+    stage_axis = pipeline_axis(mesh)
+    S = mesh.shape[stage_axis]
     V = int(virtual_stages)
     M = num_microbatches
     B = x.shape[0]
@@ -139,13 +167,14 @@ def pipeline_apply(stage_fn: Callable,
         raise ValueError(
             f"per-replica batch {B}/{repl} must be divisible by "
             f"num_microbatches={M}")
+    _warn_ragged(M, S, V)
     stage_params = _to_device_major(stage_params, S, V)
     n_entries = V * _rounded_microbatches(M, S, V)
 
     def local(params_local, x_local):
         # params_local leaves: [1, V, ...] (this device's chunks);
         # x_local: [B/repl, ...] — full batch slice for this repl row.
-        s = jax.lax.axis_index(AXIS_SHARD)
+        s = jax.lax.axis_index(stage_axis)
         mb = x_local.shape[0] // M
         xm = x_local.reshape((M, mb) + x_local.shape[1:])
         my_params = jax.tree.map(lambda p: p[0], params_local)
@@ -158,8 +187,8 @@ def pipeline_apply(stage_fn: Callable,
 
         act0 = jnp.zeros_like(xm[0])
         outs0 = compat.pcast(
-            jnp.zeros_like(xm), (AXIS_SHARD,), to="varying")
-        act0 = compat.pcast(act0, (AXIS_SHARD,), to="varying")
+            jnp.zeros_like(xm), (stage_axis,), to="varying")
+        act0 = compat.pcast(act0, (stage_axis,), to="varying")
 
         def tick(carry, t):
             act, outs = carry
@@ -185,18 +214,18 @@ def pipeline_apply(stage_fn: Callable,
                 m, axis=0)
             # hop to the next stage
             perm = [(i, (i + 1) % S) for i in range(S)]
-            act_next = jax.lax.ppermute(out, AXIS_SHARD, perm)
+            act_next = jax.lax.ppermute(out, stage_axis, perm)
             return (act_next, outs), None
 
         (_, outs), _ = jax.lax.scan(tick, (act0, outs0),
                                     jnp.arange(n_entries + S - 1))
         # only the last stage holds real outputs; broadcast them
         outs = jnp.where(s == S - 1, outs, jnp.zeros_like(outs))
-        outs = jax.lax.psum(outs, AXIS_SHARD)
+        outs = jax.lax.psum(outs, stage_axis)
         return outs.reshape(x_local.shape)
 
     spec_params = jax.tree.map(
-        lambda p: P(*((AXIS_SHARD,) + (None,) * (p.ndim - 1))),
+        lambda p: P(*((stage_axis,) + (None,) * (p.ndim - 1))),
         stage_params)
     return compat.shard_map(
         local, mesh=mesh,
@@ -267,7 +296,12 @@ def pipeline_value_and_grad(stage_fn: Callable,
     computes its loss cotangent in the same tick its forward completes —
     the defining 1F1B property, now with a V-fold smaller bubble.
     """
-    S = mesh.shape[AXIS_SHARD]
+    stage_axis = pipeline_axis(mesh)
+    S = mesh.shape[stage_axis]
+    # axes that replicate the pipeline's SPMD program: 'repl' carries
+    # data parallelism, any other non-stage axis (e.g. 'shard' on a
+    # 3-axis mesh) runs identical copies of the ring
+    data_axes = tuple(a for a in mesh.axis_names if a != stage_axis)
     V = int(virtual_stages)
     M = num_microbatches
     B = x.shape[0]
@@ -276,6 +310,7 @@ def pipeline_value_and_grad(stage_fn: Callable,
         raise ValueError(
             f"per-replica batch {B}/{repl} must be divisible by "
             f"num_microbatches={M}")
+    _warn_ragged(M, S, V)
     Bbuf = inflight_buffer_size(S, M, V)
     stage_params = _to_device_major(stage_params, S, V)
     n_entries = V * _rounded_microbatches(M, S, V)
@@ -291,7 +326,7 @@ def pipeline_value_and_grad(stage_fn: Callable,
         return jnp.mod(m // S, Bbuf // S) * S + jnp.mod(m, S)
 
     def local(params_local, head_local, x_local, y_local):
-        s = jax.lax.axis_index(AXIS_SHARD)
+        s = jax.lax.axis_index(stage_axis)
         mb = x_local.shape[0] // M
         xm = x_local.reshape((M, mb) + x_local.shape[1:])
         ym = jax.tree.map(
@@ -302,11 +337,11 @@ def pipeline_value_and_grad(stage_fn: Callable,
         # those axes inserted by the transpose — a per-tick collective,
         # and a double-count with the one reduction we do at the end.
         my_params = jax.tree.map(
-            lambda p: compat.pcast(p, (AXIS_REPL,), to="varying"),
+            lambda p: compat.pcast(p, data_axes, to="varying"),
             my_params)
 
         def vary_all(a):
-            for ax in (AXIS_REPL, AXIS_SHARD):
+            for ax in mesh.axis_names:
                 a = compat.pcast(a, (ax,), to="varying")
             return a
 
@@ -377,32 +412,42 @@ def pipeline_value_and_grad(stage_fn: Callable,
                 mb_i, axis=0)
             # ---- hops ----
             out = jnp.where(fwd_active, out, jnp.zeros_like(out))
-            act_next = jax.lax.ppermute(out, AXIS_SHARD, fwd_perm)
+            act_next = jax.lax.ppermute(out, stage_axis, fwd_perm)
             dinp = jnp.where(bwd_active, dinp, jnp.zeros_like(dinp))
-            ct_next = jax.lax.ppermute(dinp, AXIS_SHARD, bwd_perm)
+            ct_next = jax.lax.ppermute(dinp, stage_axis, bwd_perm)
             return (act_next, ct_next, buf, gacc, hacc, xg, lacc), None
 
         n_ticks = n_entries + C
         (_, _, _, gacc, hacc, xg, lacc), _ = jax.lax.scan(
             tick, (act0, ct0, buf0, gacc0, hacc0, xg0, lacc0),
             jnp.arange(n_ticks))
+
+        def mean_data(a):
+            # average over the data axes: 'repl' rows each saw a real
+            # batch slice; any other non-stage axis ran an identical
+            # copy, so its pmean is numerically a no-op that restores
+            # axis-invariance for the out_specs
+            for ax in data_axes:
+                a = jax.lax.pmean(a, ax)
+            return a
+
         # loss lives on the last stage; data-parallel rows average
-        loss = jax.lax.psum(lacc, AXIS_SHARD)
-        loss = jax.lax.pmean(loss, AXIS_REPL)
-        g_stage = jax.tree.map(
-            lambda g: jax.lax.pmean(g, AXIS_REPL)[None], gacc)
+        loss = mean_data(jax.lax.psum(lacc, stage_axis))
+        g_stage = jax.tree.map(lambda g: mean_data(g)[None], gacc)
         # head grads live on the last stage only (masked elsewhere)
         g_head = jax.tree.map(
-            lambda g: jax.lax.pmean(jax.lax.psum(g, AXIS_SHARD),
-                                    AXIS_REPL), hacc)
+            lambda g: mean_data(jax.lax.psum(g, stage_axis)), hacc)
         # x cotangent lives on stage 0; scale to the global-mean loss
         # (each row accumulated d(row-mean)/dx; loss averages the rows)
-        xg = jax.lax.psum(xg, AXIS_SHARD) / repl
+        xg = jax.lax.psum(xg, stage_axis) / repl
+        for ax in data_axes:
+            if ax != AXIS_REPL:
+                xg = jax.lax.pmean(xg, ax)
         g_x = xg.reshape(x_local.shape)
         return loss, g_stage, g_head, g_x
 
     spec_params = jax.tree.map(
-        lambda p: P(*((AXIS_SHARD,) + (None,) * (p.ndim - 1))),
+        lambda p: P(*((stage_axis,) + (None,) * (p.ndim - 1))),
         stage_params)
     head_specs = jax.tree.map(lambda _: P(), head_params)
     y_specs = jax.tree.map(lambda _: P(AXIS_REPL), y)

@@ -551,7 +551,7 @@ class ParallaxSession:
             bucketing_lib.batch_signature(example_batch),)
         engine = self._engine_cache.get(key)
         if engine is None:
-            mesh = mesh_lib.build_mesh(shape=(plan.dp, plan.tp))
+            mesh = mesh_lib.build_mesh(shape=plan.mesh_shape())
             engine = engine_lib.Engine(self._model, mesh,
                                        self._engine_config(plan),
                                        example_batch,
@@ -614,8 +614,13 @@ class ParallaxSession:
         def rebind(x):
             if hasattr(x, "sharding") and isinstance(x.sharding,
                                                      NamedSharding):
+                # a plan change can also change the AXIS SET (pp > 1
+                # adds 'pipe'): resolve_spec folds 'pipe' onto 'shard'
+                # when the new mesh has no pipeline axis, so a 3-axis
+                # plan's state reshards cleanly back onto a 2-axis one
+                spec = mesh_lib.resolve_spec(x.sharding.spec, new_mesh)
                 return jax.device_put(
-                    x, NamedSharding(new_mesh, x.sharding.spec))
+                    x, NamedSharding(new_mesh, spec))
             return x
 
         rest = state.replace(params=new_params)

@@ -63,14 +63,18 @@ def predicted_terms_from_cost(terms: Dict[str, float]
                               ) -> Dict[str, float]:
     """Collapse a ``PlanCost.terms`` breakdown (seconds) onto the two
     calibrated terms: ``on_chip = max(compute, hbm)`` (the roofline
-    takes the binding ceiling) and ``wire`` = every interconnect term
-    (the hidden share under sync=False stays excluded — it was never
-    predicted to cost wall time)."""
+    takes the binding ceiling — on pp>1 plans compute/hbm already
+    carry the bubble scale, so the bubble calibrates with on_chip)
+    and ``wire`` = every interconnect term, including the pp>1 plans'
+    inter-stage ppermute stream ``wire_pp_s`` (the hidden share under
+    sync=False stays excluded — it was never predicted to cost wall
+    time)."""
     on_chip = max(float(terms.get("compute_s", 0.0)),
                   float(terms.get("hbm_s", 0.0)))
     wire = (float(terms.get("wire_dense_s", 0.0))
             + float(terms.get("wire_zero_shard_s", 0.0))
             + float(terms.get("wire_table_s", 0.0))
+            + float(terms.get("wire_pp_s", 0.0))
             - float(terms.get("wire_hidden_s", 0.0)))
     return {"on_chip": on_chip, "wire": max(0.0, wire)}
 

@@ -70,11 +70,18 @@ class Plan:
 
     ``dp`` is the ``'repl'`` axis size (data-parallel replica rows),
     ``tp`` the ``'shard'`` axis size (row-shard width — the
-    reference's embedding partition count). ``sync`` /
-    ``local_aggregation`` ride along from the session config (the
-    search varies mesh shape and run option); they are part of the
-    plan so the cache key, the cost breakdown, and the dryrun phase
-    list all name the complete configuration.
+    reference's embedding partition count), ``pp`` the ``'pipe'``
+    axis size (pipeline stages, ISSUE 18; 1 means no pipe axis and
+    the exact pre-PR-18 two-axis mesh). ``virtual_stages`` /
+    ``microbatches`` are the pipeline schedule knobs a ``pp>1`` plan
+    carries (the tuner copies them from the model's declared
+    ``pipeline_info``); both stay at their neutral defaults on 2-D
+    plans — validated, so a pp=1 plan can never smuggle schedule
+    state into the cache key. ``sync`` / ``local_aggregation`` ride
+    along from the session config (the search varies mesh shape and
+    run option); they are part of the plan so the cache key, the
+    cost breakdown, and the dryrun phase list all name the complete
+    configuration.
     """
 
     dp: int
@@ -82,14 +89,31 @@ class Plan:
     run_option: str = consts.RUN_HYBRID
     sync: bool = True
     local_aggregation: bool = True
+    pp: int = 1
+    virtual_stages: int = 1
+    microbatches: int = 0
 
     def __post_init__(self):
-        if int(self.dp) < 1 or int(self.tp) < 1:
+        if int(self.dp) < 1 or int(self.tp) < 1 or int(self.pp) < 1:
             raise ValueError(
                 f"plan mesh axes must be >= 1, got dp={self.dp} "
-                f"tp={self.tp}")
+                f"tp={self.tp} pp={self.pp}")
+        if int(self.virtual_stages) < 1 or int(self.microbatches) < 0:
+            raise ValueError(
+                f"virtual_stages must be >= 1 and microbatches >= 0, "
+                f"got virtual_stages={self.virtual_stages} "
+                f"microbatches={self.microbatches}")
+        if int(self.pp) == 1 and (int(self.virtual_stages) != 1
+                                  or int(self.microbatches) != 0):
+            raise ValueError(
+                "pipeline knobs (virtual_stages/microbatches) require "
+                "pp > 1")
         object.__setattr__(self, "dp", int(self.dp))
         object.__setattr__(self, "tp", int(self.tp))
+        object.__setattr__(self, "pp", int(self.pp))
+        object.__setattr__(self, "virtual_stages",
+                           int(self.virtual_stages))
+        object.__setattr__(self, "microbatches", int(self.microbatches))
         object.__setattr__(self, "run_option",
                            normalize_run_option(self.run_option))
         object.__setattr__(self, "sync", bool(self.sync))
@@ -98,30 +122,49 @@ class Plan:
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.tp
+        return self.dp * self.tp * self.pp
+
+    def mesh_shape(self) -> Tuple[int, ...]:
+        """The ``build_mesh(shape=...)`` tuple for this plan: the
+        legacy 2-tuple at pp=1 (the exact pre-PR-18 mesh), the
+        3-tuple otherwise."""
+        if self.pp == 1:
+            return (self.dp, self.tp)
+        return (self.dp, self.tp, self.pp)
 
     def validate_for(self, num_devices: int) -> "Plan":
-        """Refuse a plan whose dp*tp product does not tile the mesh."""
+        """Refuse a plan whose dp*tp*pp product does not tile the
+        mesh."""
         if self.num_devices != int(num_devices):
             raise ValueError(
                 f"plan {self.describe()} covers {self.num_devices} "
-                f"devices but the mesh has {num_devices}; dp*tp must "
-                f"equal the device count")
+                f"devices but the mesh has {num_devices}; dp*tp*pp "
+                f"must equal the device count")
         return self
 
     def cache_key(self) -> Tuple:
         """The engine-cache key prefix: every field that changes the
         compiled program. Two plans with equal device counts but
         different mesh shape or run option MUST key apart (ISSUE 10
-        bugfix — the old ``(num_partitions, sig)`` key collided
-        them)."""
+        bugfix — the old ``(num_partitions, sig)`` key collided them;
+        ISSUE 18 extends the shape to the full 3-tuple plus schedule
+        knobs for the same reason)."""
         return (self.dp, self.tp, self.run_option, self.sync,
-                self.local_aggregation)
+                self.local_aggregation, self.pp, self.virtual_stages,
+                self.microbatches)
 
     def describe(self) -> str:
         tags = [] if self.sync else ["async"]
         if not self.local_aggregation:
             tags.append("noagg")
+        if self.pp > 1:
+            if self.virtual_stages > 1:
+                tags.append(f"v{self.virtual_stages}")
+            if self.microbatches:
+                tags.append(f"m{self.microbatches}")
+            return (f"dp{self.dp}xtp{self.tp}xpp{self.pp}"
+                    f"/{self.run_option}"
+                    + ("".join("+" + t for t in tags)))
         return (f"dp{self.dp}xtp{self.tp}/{self.run_option}"
                 + ("".join("+" + t for t in tags)))
 
@@ -175,6 +218,16 @@ class CostInputs:
     # predicted term is divided by its ratio, replacing the nominal
     # exchange rates with measured ones — rig-relative by design.
     calibration: Optional[Dict[str, float]] = None
+    # Pipeline capability record (ISSUE 18), present iff the probed
+    # model declared ``Model.pipeline_info``. Keys: ``schedule``
+    # ('gpipe'|'1f1b'), ``microbatches``, ``virtual_stages``,
+    # ``pinned_stages`` (stage count baked into a V>1 layer storage
+    # order, else None), ``num_layers``, ``act_bytes`` (global-batch
+    # activation bytes at one stage boundary), ``global_batch``, and
+    # optionally ``layer_costs`` (per-layer relative flop/byte
+    # weights; None means uniform). pp>1 plans can only be priced —
+    # and only get enumerated — when this record exists.
+    pipeline: Optional[Dict[str, Any]] = None
 
     def resolved(self) -> "CostInputs":
         out = dataclasses.replace(self)
@@ -200,17 +253,26 @@ class PlanCost:
     # None for a nominal-constants prediction — every downstream
     # artifact can tell a calibrated score from a nominal one
     calibration: Optional[Dict[str, float]] = None
+    # pp>1 plans only: the schedule record that explains the score —
+    # bubble fraction, rounded microbatch count, and the balanced
+    # stage cut (so ``tune_decision`` shows WHERE the layers were
+    # split and what the bubble cost)
+    pipeline: Optional[Dict[str, Any]] = None
 
     def as_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "plan": self.plan.describe(),
             "dp": self.plan.dp, "tp": self.plan.tp,
+            "pp": self.plan.pp,
             "run_option": self.plan.run_option,
             "predicted_ms": round(self.total_s * 1e3, 6),
             "terms_ms": {k: round(v * 1e3, 6)
                          for k, v in self.terms.items()},
             "calibration": self.calibration,
         }
+        if self.pipeline is not None:
+            out["pipeline"] = self.pipeline
+        return out
 
 
 def ring_allreduce_bytes(payload_bytes: float, k: int) -> float:
@@ -275,11 +337,174 @@ def wire_summary(wire: Dict[str, Any],
     }
 
 
+def pipeline_bubble(microbatches: int, stages: int,
+                    virtual_stages: int = 1) -> Dict[str, float]:
+    """Bubble accounting of the SPMD pipeline schedules in
+    ``ops/pipeline.py`` — the ONE owner of the tick math.
+
+    The interleaved schedule rounds M up to whole rounds of S
+    (``ops/pipeline._rounded_microbatches``); the ragged padding runs
+    masked bubble entries, so the model prices the ROUNDED M — the
+    predicted bubble matches what actually executes (ISSUE 18
+    satellite). Ticks = V*M_sched + S - 1, ideal = V*M, so
+
+        bubble_fraction = (S - 1) / (V*M_sched + S - 1)
+        on_chip_scale   = (V*M_sched + S - 1) / (V*M)
+
+    ``on_chip_scale`` multiplies the plan's on-chip roofline term: at
+    M % S == 0 it equals 1/(1 - bubble_fraction)."""
+    M, S, V = int(microbatches), int(stages), int(virtual_stages)
+    if M < 1 or S < 1 or V < 1:
+        raise ValueError(
+            f"pipeline_bubble needs M, S, V >= 1; got M={M} S={S} "
+            f"V={V}")
+    m_sched = M if V == 1 else -(-M // S) * S
+    ticks = V * m_sched + S - 1
+    return {
+        "bubble_fraction": (S - 1) / ticks,
+        "on_chip_scale": ticks / (V * M),
+        "microbatches_scheduled": m_sched,
+        "ticks": ticks,
+    }
+
+
+def pipeline_wire_bytes(act_bytes: float, microbatches: int,
+                        stages: int, virtual_stages: int = 1,
+                        schedule: str = "gpipe", dp: int = 1,
+                        tp: int = 1) -> Dict[str, float]:
+    """Inter-stage transfer accounting — the ONE owner of the
+    pipeline wire math (``predict`` and ``tools/wire_bytes_report.py``
+    both call it).
+
+    ``act_bytes`` is the GLOBAL-batch activation at one stage
+    boundary; one ppermute hop carries one microbatch of one replica
+    row, ``per_hop_bytes = act_bytes / (M * dp)``. The SPMD schedule
+    ppermutes EVERY tick on every device (masked entries move zeros —
+    physically real traffic), so the mesh-global activation bytes are
+    ``per_hop * dp * tp * S * ticks`` (``tp`` columns each run an
+    identical ring). Under 1F1B the cotangent stream mirrors the
+    forward hops and doubles the total."""
+    M = int(microbatches)
+    bub = pipeline_bubble(M, stages, virtual_stages)
+    per_hop = float(act_bytes) / (M * max(int(dp), 1))
+    sends_per_tick = max(int(dp), 1) * max(int(tp), 1) * int(stages)
+    activation = per_hop * sends_per_tick * bub["ticks"]
+    cotangent = activation if str(schedule) == "1f1b" else 0.0
+    return {
+        "per_hop_bytes": per_hop,
+        "ticks": bub["ticks"],
+        "bubble_fraction": bub["bubble_fraction"],
+        "microbatches_scheduled": bub["microbatches_scheduled"],
+        "activation_bytes": activation,
+        "cotangent_bytes": cotangent,
+        "total_bytes": activation + cotangent,
+    }
+
+
+def balanced_stage_cut(layer_costs: Sequence[float],
+                       stages: int) -> Tuple[list, list]:
+    """Contiguous partition of per-layer costs into ``stages`` groups
+    minimizing the maximum group sum (classic linear-partition DP).
+    Returns ``(boundaries, stage_sums)``: ``boundaries`` has
+    ``stages + 1`` entries with ``boundaries[s]:boundaries[s+1]`` the
+    layers of stage s. The tuner records the cut in the scored
+    artifact so ``tune_decision`` explains where the layers were
+    split; the imbalance factor ``stages * max(sums) / sum(sums)``
+    scales the on-chip term (a perfectly balanced cut scores 1)."""
+    costs = [float(c) for c in layer_costs]
+    L, S = len(costs), int(stages)
+    if S < 1 or L < S:
+        raise ValueError(
+            f"balanced_stage_cut needs 1 <= stages <= num_layers; "
+            f"got stages={S} over {L} layer(s)")
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + c)
+
+    def span(i, j):
+        return prefix[j] - prefix[i]
+
+    # dp[s][j] = minimal max-group-sum splitting costs[:j] into s groups
+    INF = float("inf")
+    dp_tab = [[INF] * (L + 1) for _ in range(S + 1)]
+    cut = [[0] * (L + 1) for _ in range(S + 1)]
+    dp_tab[0][0] = 0.0
+    for s in range(1, S + 1):
+        for j in range(s, L + 1):
+            for i in range(s - 1, j):
+                cand = max(dp_tab[s - 1][i], span(i, j))
+                if cand < dp_tab[s][j]:
+                    dp_tab[s][j] = cand
+                    cut[s][j] = i
+    bounds = [L]
+    j = L
+    for s in range(S, 0, -1):
+        j = cut[s][j]
+        bounds.append(j)
+    bounds.reverse()
+    sums = [span(bounds[s], bounds[s + 1]) for s in range(S)]
+    return bounds, sums
+
+
 def predict(plan: Plan, inputs: CostInputs) -> PlanCost:
     """Score one plan. Pure; see the module docstring for the model."""
     inp = inputs.resolved()
     n = plan.num_devices
-    compute_s = float(inp.flops) / (n * inp.peak_flops)
+
+    # ---- pipeline terms (ISSUE 18): a pp>1 plan scales its on-chip
+    # roofline by the bubble (rounded-M ticks over ideal work) times
+    # the stage-cut imbalance, and adds an inter-stage ppermute wire
+    # term. pp=1 plans take none of this path — their breakdown stays
+    # byte-identical to the 2-D model.
+    on_scale = 1.0
+    wire_pp = 0.0
+    pp_record = None
+    if plan.pp > 1:
+        pl = inp.pipeline
+        if not pl:
+            raise ValueError(
+                f"plan {plan.describe()} has pp>1 but "
+                "CostInputs.pipeline is missing — only models that "
+                "declare pipeline_info can be priced for pipeline "
+                "plans")
+        S = plan.pp
+        V = max(int(plan.virtual_stages), 1)
+        M = int(plan.microbatches
+                or pl.get("microbatches") or 1)
+        schedule = str(pl.get("schedule") or "gpipe")
+        layer_costs = pl.get("layer_costs")
+        if not layer_costs and pl.get("num_layers"):
+            layer_costs = [1.0] * int(pl["num_layers"])
+        cut, sums, imbalance = None, None, 1.0
+        if layer_costs:
+            cut, sums = balanced_stage_cut(layer_costs, S)
+            total_c = sum(sums)
+            imbalance = (S * max(sums) / total_c) if total_c else 1.0
+        bub = pipeline_bubble(M, S, V)
+        on_scale = bub["on_chip_scale"] * imbalance
+        act_bytes = float(pl.get("act_bytes") or 0.0)
+        if not act_bytes:
+            # derivable fallback: one stage boundary carries the whole
+            # global batch's [tokens, model_dim] activation
+            act_bytes = (float(pl.get("global_batch") or 0)
+                         * float(pl.get("model_dim") or 0)
+                         * float(pl.get("act_itemsize") or 4))
+        wires = pipeline_wire_bytes(
+            act_bytes, M, S, V,
+            schedule=schedule, dp=plan.dp, tp=plan.tp)
+        wire_pp = wires["total_bytes"]
+        pp_record = {
+            "pp": S, "virtual_stages": V, "microbatches": M,
+            "microbatches_scheduled": bub["microbatches_scheduled"],
+            "schedule": schedule,
+            "bubble_fraction": round(bub["bubble_fraction"], 6),
+            "imbalance": round(imbalance, 6),
+            "stage_cut": cut,
+            "stage_costs": ([round(v, 6) for v in sums]
+                            if sums else None),
+        }
+
+    compute_s = float(inp.flops) / (n * inp.peak_flops) * on_scale
     # kernel-aware HBM term: stream bytes split across devices like
     # cost_analysis bytes; resident (weight-fetch) bytes are paid per
     # device, so the mesh-global total is resident * n
@@ -287,7 +512,7 @@ def predict(plan: Plan, inputs: CostInputs) -> PlanCost:
                   + float(inp.lstm_resident_bytes) * n)
     attn_bytes = float(inp.attn_stream_bytes)
     hbm_s = (float(inp.hbm_bytes) + lstm_bytes + attn_bytes) \
-        / (n * inp.hbm_bps)
+        / (n * inp.hbm_bps) * on_scale
 
     # dense (non-table) grads: full-mesh ring in every run option (the
     # batch axis spans the whole mesh, so every device holds a full
@@ -326,7 +551,7 @@ def predict(plan: Plan, inputs: CostInputs) -> PlanCost:
                 inp.table_grad_bytes / max(plan.tp, 1), plan.dp)
         wire_table = fwd + repl
 
-    wire_bytes = wire_dense + wire_zero + wire_table
+    wire_bytes = wire_dense + wire_zero + wire_table + wire_pp
     # measured calibration (tune/calibrate.py): each term divides by
     # its persisted predicted/measured ratio, replacing the nominal
     # exchange rates with the rig's measured ones. Applied to the
@@ -343,7 +568,7 @@ def predict(plan: Plan, inputs: CostInputs) -> PlanCost:
     # overlaps the next step's compute; only the excess serializes
     hidden_s = min(wire_s, compute_s) if not plan.sync else 0.0
     total = max(compute_s, hbm_s) + (wire_s - hidden_s)
-    return PlanCost(plan=plan, total_s=total, terms={
+    terms = {
         "compute_s": compute_s,
         "hbm_s": hbm_s,
         # informational sub-term (INCLUDED in hbm_s, not additive):
@@ -356,7 +581,18 @@ def predict(plan: Plan, inputs: CostInputs) -> PlanCost:
         "wire_zero_shard_s": wire_zero / (n * inp.ici_bps) / r_wire,
         "wire_table_s": wire_table / (n * inp.ici_bps) / r_wire,
         "wire_hidden_s": hidden_s,
-    }, calibration=(dict(cal) if cal else None))
+    }
+    if plan.pp > 1:
+        # the inter-stage ppermute stream (ADDITIVE, part of wire_s);
+        # calibrate.py folds it into the 'wire' term like any other
+        terms["wire_pp_s"] = wire_pp / (n * inp.ici_bps) / r_wire
+        # informational: the on-chip seconds the bubble + stage-cut
+        # imbalance added (INCLUDED in compute_s/hbm_s, not additive)
+        terms["pp_bubble_s"] = (max(compute_s, hbm_s)
+                                * (1.0 - 1.0 / on_scale))
+    return PlanCost(plan=plan, total_s=total, terms=terms,
+                    calibration=(dict(cal) if cal else None),
+                    pipeline=pp_record)
 
 
 def inputs_from_engine(engine, tune_config=None,
@@ -448,6 +684,30 @@ def inputs_from_engine(engine, tune_config=None,
             attn_stream += acct["total_bytes"]
     except Exception:   # never fail plan pricing for the hint term
         pass
+    # pipeline capability (ISSUE 18): a model that declares
+    # pipeline_info makes pp>1 plans enumerable and priceable. The
+    # boundary activation bytes come from the probe's batch shapes —
+    # [B, T] leading feed x model_dim x activation element size.
+    pipeline = None
+    pinfo = getattr(getattr(engine, "model", None),
+                    "pipeline_info", None)
+    if pinfo:
+        pipeline = dict(pinfo)
+        shapes = getattr(engine, "_batch_shapes", None)
+        lead = None
+        if isinstance(shapes, dict):
+            for leaf in jax.tree.leaves(shapes):
+                shp = getattr(leaf, "shape", None)
+                if shp and len(shp) >= 1:
+                    if lead is None or len(shp) > len(lead):
+                        lead = tuple(shp)
+        if lead:
+            b = int(lead[0])
+            tokens = b * int(lead[1]) if len(lead) > 1 else b
+            pipeline.setdefault("global_batch", b)
+            dim = int(pipeline.get("model_dim") or 0)
+            elem = int(pipeline.get("act_itemsize") or 4)
+            pipeline.setdefault("act_bytes", tokens * dim * elem)
     dev = jax.devices()[0]
     import os
     peak = flops_lib.device_peak_flops(
@@ -469,4 +729,5 @@ def inputs_from_engine(engine, tune_config=None,
         ici_bps=(tc.ici_gbps * 1e9 if tc and tc.ici_gbps else None),
         peak_is_nominal=not bool(
             (tc and tc.peak_flops) or peak),
-        calibration=calibration)
+        calibration=calibration,
+        pipeline=pipeline)

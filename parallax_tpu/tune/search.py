@@ -44,29 +44,82 @@ def _divisors(n: int) -> List[int]:
     return [d for d in range(1, n + 1) if n % d == 0]
 
 
+def _pipeline_pp_values(num_devices: int, max_pp: Optional[int],
+                        pipeline: Optional[Dict]) -> List[int]:
+    """Admissible ``pp > 1`` values for the 3-D lattice (ISSUE 18).
+
+    Empty without a model-declared ``pipeline`` capability record or
+    with ``max_pp <= 1`` — the pp dimension exists only when the model
+    can execute it. Constraints: ``pp`` divides the device count and
+    ``num_layers % (pp * virtual_stages) == 0`` (the stage stacking is
+    an even reshape); a layer storage order baked for ``V > 1``
+    (``pinned_stages``) pins ``pp`` to that stage count."""
+    if not pipeline or not max_pp or int(max_pp) <= 1:
+        return []
+    layers = int(pipeline.get("num_layers") or 0)
+    virtual = max(int(pipeline.get("virtual_stages") or 1), 1)
+    pinned = pipeline.get("pinned_stages")
+    micro = int(pipeline.get("microbatches") or 0)
+    if layers < 1 or micro < 1:
+        return []
+    out = []
+    for pp in _divisors(int(num_devices)):
+        if pp == 1 or pp > int(max_pp):
+            continue
+        if virtual > 1 and pinned and pp != int(pinned):
+            continue
+        if layers % (pp * virtual):
+            continue
+        out.append(pp)
+    return out
+
+
 def enumerate_plans(num_devices: int,
                     run_options: Optional[Sequence[str]] = None,
                     sync: bool = True,
                     local_aggregation: bool = True,
                     min_tp: int = 1,
-                    max_tp: Optional[int] = None) -> List[Plan]:
-    """The FULL ``(dp x tp) x run_option`` space: one plan per divisor
-    ``tp`` of ``num_devices`` (``dp = num_devices // tp``) per run
-    option, bounded by ``[min_tp, max_tp]``. No equivalence pruning —
-    see :func:`emittable_plans` for the deduped list."""
+                    max_tp: Optional[int] = None,
+                    max_pp: Optional[int] = None,
+                    pipeline: Optional[Dict] = None) -> List[Plan]:
+    """The FULL ``(dp x tp x pp) x run_option`` space: one plan per
+    divisor ``tp`` of ``num_devices // pp`` per run option per
+    admissible ``pp``, bounded by ``[min_tp, max_tp]``. The ``pp = 1``
+    block comes first and is byte-identical to the pre-PR-18 2-D list;
+    ``pp > 1`` blocks exist only when a ``pipeline`` capability record
+    is given and ``max_pp > 1`` (see :func:`_pipeline_pp_values`). No
+    equivalence pruning — see :func:`emittable_plans` for the deduped
+    list."""
     if num_devices < 1:
         raise ValueError(f"num_devices must be >= 1, got {num_devices}")
     opts = tuple(run_options) if run_options else (
         consts.RUN_AR, consts.RUN_SHARD, consts.RUN_HYBRID)
     hi = min(int(max_tp), num_devices) if max_tp else num_devices
     out = []
-    for tp in _divisors(num_devices):
-        if tp < int(min_tp) or tp > hi:
-            continue
-        for opt in opts:
-            out.append(Plan(dp=num_devices // tp, tp=tp,
-                            run_option=opt, sync=sync,
-                            local_aggregation=local_aggregation))
+    pp_values = [1] + _pipeline_pp_values(num_devices, max_pp, pipeline)
+    for pp in pp_values:
+        if pp == 1:
+            virtual, micro = 1, 0
+        else:
+            virtual = max(int(pipeline.get("virtual_stages") or 1), 1)
+            micro = int(pipeline.get("microbatches") or 1)
+        gb = int(pipeline.get("global_batch") or 0) if pipeline else 0
+        for tp in _divisors(num_devices // pp):
+            if tp < int(min_tp) or tp > hi:
+                continue
+            dp = num_devices // pp // tp
+            if pp > 1 and gb and (gb % dp
+                                  or (gb // dp) % max(micro, 1)):
+                # the schedule needs the per-replica batch to split
+                # into whole microbatches — an inadmissible (dp, M)
+                # pairing can never execute, so it never enumerates
+                continue
+            for opt in opts:
+                out.append(Plan(dp=dp, tp=tp, run_option=opt,
+                                sync=sync,
+                                local_aggregation=local_aggregation,
+                                pp=pp, virtual_stages=virtual,
+                                microbatches=micro))
     return out
 
 
@@ -75,27 +128,32 @@ def emittable_plans(num_devices: int,
                     sync: bool = True,
                     local_aggregation: bool = True,
                     min_tp: int = 1,
-                    max_tp: Optional[int] = None) -> List[Plan]:
+                    max_tp: Optional[int] = None,
+                    max_pp: Optional[int] = None,
+                    pipeline: Optional[Dict] = None) -> List[Plan]:
     """The deduped plan list — every configuration the tuner can
     actually emit (and the list the multichip dryrun proves).
 
-    Collapsed equivalences: every ``tp == 1`` plan (AR included) is
-    the same all-replicated program, so exactly one survives; AR
+    Collapsed equivalences, applied independently per ``pp`` block:
+    every ``tp == 1`` plan (AR included) is the same all-replicated
+    program at that ``pp``, so exactly one survives per block; AR
     ignores the shard axis, so only its canonical ``tp == 1`` shape is
-    kept (it survives ``min_tp`` — there is no other shape AR
-    compiles distinctly at)."""
+    kept (it survives ``min_tp`` — there is no other shape AR compiles
+    distinctly at). With ``pp`` forced to 1 (the default) the list is
+    byte-identical to the pre-PR-18 space."""
     opts = tuple(run_options) if run_options else (
         consts.RUN_AR, consts.RUN_SHARD, consts.RUN_HYBRID)
     plans = enumerate_plans(num_devices, opts, sync, local_aggregation,
-                            min_tp=1, max_tp=max_tp)
+                            min_tp=1, max_tp=max_tp, max_pp=max_pp,
+                            pipeline=pipeline)
     out = []
-    seen_replicated = False
+    seen_replicated = set()   # pp values whose tp=1 canonical is kept
     for p in plans:
         if p.tp == 1:
-            if seen_replicated or (consts.RUN_AR not in opts
-                                   and int(min_tp) > 1):
+            if p.pp in seen_replicated or (consts.RUN_AR not in opts
+                                           and int(min_tp) > 1):
                 continue
-            seen_replicated = True
+            seen_replicated.add(p.pp)
             out.append(p)
             continue
         if p.run_option == consts.RUN_AR:
@@ -196,15 +254,21 @@ class MeshSearch:
         # min_tp bound) is always a subset of it and the pruned count
         # can never go negative or undercount; the double enumeration
         # is O(divisors x options) — trivially cheap
+        # the pp dimension (ISSUE 18) opens only when the probed model
+        # declared pipeline capability AND the config allows pp > 1 —
+        # otherwise both lists are exactly the 2-D space
+        max_pp = getattr(cfg, "max_pp", 1)
         full = enumerate_plans(
             self.num_devices, opts, sync=self.base_plan.sync,
             local_aggregation=self.base_plan.local_aggregation,
-            min_tp=1, max_tp=cfg.max_tp)
+            min_tp=1, max_tp=cfg.max_tp, max_pp=max_pp,
+            pipeline=inputs.pipeline)
         self._enumerated = len(full)
         plans = emittable_plans(
             self.num_devices, opts, sync=self.base_plan.sync,
             local_aggregation=self.base_plan.local_aggregation,
-            min_tp=cfg.min_tp, max_tp=cfg.max_tp)
+            min_tp=cfg.min_tp, max_tp=cfg.max_tp, max_pp=max_pp,
+            pipeline=inputs.pipeline)
         # equivalence-collapsed AND bound-pruned plans both count here;
         # non-empty is guaranteed by the constructor's bounds check
         self._pruned_equivalent = len(full) - len(plans)
@@ -342,12 +406,18 @@ class MeshSearch:
             winner = {
                 "plan": self._best.describe(),
                 "dp": self._best.dp, "tp": self._best.tp,
+                "pp": self._best.pp,
                 "run_option": self._best.run_option,
                 "predicted_ms": (round(pc.total_s * 1e3, 6)
                                  if pc else None),
                 "measured_ms": round(m * 1e3, 6),
                 "predicted_over_measured": (
                     round(pc.total_s / m, 6) if pc and m else None),
+                # None on a 2-D winner; a pp>1 winner carries its
+                # priced bubble so the bench tune block can gate it
+                "bubble_fraction": (
+                    (pc.pipeline or {}).get("bubble_fraction")
+                    if pc else None),
             }
         inp = self._inputs
         basis = ("nominal-constants (CPU-relative ranking)"
@@ -368,6 +438,12 @@ class MeshSearch:
             "hbm_budget_bytes": self._hbm_budget,
             "hbm_headroom": float(self.cfg.hbm_headroom),
             "preflight_checked": self._preflight_checked,
+            # the pp dimension's gate state (ISSUE 18): whether the
+            # probed model could pipeline at all, and the cap — so a
+            # record with no pp>1 candidates explains itself
+            "max_pp": int(getattr(self.cfg, "max_pp", 1) or 1),
+            "pipeline_capable": bool(inp is not None
+                                     and inp.pipeline),
             "top_k": int(self.cfg.top_k),
             "trials": trials,
             "trials_measured": len(self._measured),

@@ -77,6 +77,73 @@ def _spearman(a, b):
     return float((ra * rb).sum() / denom) if denom else 0.0
 
 
+def _pp_pool():
+    """Measured ranking over a PIPELINE plan pool (ISSUE 18): the 3-D
+    lattice's pp > 1 plans vs their dp-only peer on a pipeline-capable
+    LM, ranked by the analytic bubble + inter-stage-wire pricing.
+    ``max_tp=1`` keeps the pool to genuinely distinct-execution plans
+    — tp separations are already ranked by the main sweep, and on this
+    rig their near-ties would only add rank noise to the pp signal.
+    ``max_pp=4`` and a shorter timing window than the main sweep keep
+    the pool's wall cost tier-1-sized: pipeline steps are the slowest
+    programs this driver runs, and 3 plans x 3 rounds separate cleanly
+    on this rig (the dp-only peer is several times faster than any
+    bubble-paying pipeline)."""
+    from parallax_tpu.models import long_context as lc
+
+    pp_rounds, pp_steps = 3, 3
+    cfg = lc.tiny_config(parallelism="pipeline", num_layers=8,
+                         num_microbatches=4, pipeline_schedule="gpipe",
+                         compute_dtype=jnp.float32)
+    probe_model = lc.build_model(cfg)
+    batch = lc.make_batch(np.random.default_rng(11), 32, 16,
+                          cfg.vocab_size)
+    plans = emittable_plans(8, run_options=("HYBRID",), max_tp=1,
+                            max_pp=4, pipeline=probe_model.pipeline_info)
+    ents = []
+    for plan in plans:
+        cfg_p = parallax.Config(run_option=plan.run_option,
+                                search_partitions=False)
+        mesh = mesh_lib.build_mesh(shape=plan.mesh_shape())
+        eng = engine_lib.Engine(lc.build_model(cfg), mesh, cfg_p, batch)
+        state = eng.init_state(0)
+        for _ in range(WARMUP):
+            state, _ = eng.step(state, batch)
+        jax.block_until_ready(state.params)
+        ents.append([plan, eng, state, []])
+    for _round in range(pp_rounds):
+        for ent in ents:
+            plan, eng, state, ts = ent
+            t0 = time.perf_counter()
+            for _ in range(pp_steps):
+                state, _ = eng.step(state, batch)
+            jax.block_until_ready(state.params)
+            ts.append((time.perf_counter() - t0) / pp_steps)
+            ent[2] = state
+    probe = costmodel.inputs_from_engine(
+        next(e for p, e, *_ in ents if p.pp == 1))
+    measured, predicted, rows = [], [], []
+    for plan, _eng, _state, ts in ents:
+        t = min(ts)
+        pred = costmodel.predict(plan, probe)
+        measured.append(t)
+        predicted.append(pred.total_s)
+        rows.append({
+            "plan": plan.describe(),
+            "pp": plan.pp,
+            "measured_ms": round(t * 1e3, 3),
+            "predicted_ms": round(pred.total_s * 1e3, 6),
+            "bubble_fraction": (pred.pipeline or {}).get(
+                "bubble_fraction"),
+        })
+    return {
+        "n_plans": len(plans),
+        "spearman": round(_spearman(np.asarray(predicted),
+                                    np.asarray(measured)), 4),
+        "rows": rows,
+    }
+
+
 def main() -> int:
     top_k = 3
     sess, *_ = parallax.parallel_run(
@@ -207,8 +274,17 @@ def main() -> int:
             for ent in exhaustive.values()]
         spearman_cal = round(_spearman(np.asarray(predicted_cal),
                                        np.asarray(measured)), 4)
+    # the pipeline plan pool rides the same driver process: a second
+    # XLA:CPU multi-mesh process per tier-1 run would double the
+    # crash-retry surface this file exists to contain
+    try:
+        pp_pool = _pp_pool()
+    except Exception as e:
+        pp_pool = {"error": f"{type(e).__name__}: {e}"}
+
     result = {
         "converged": converged,
+        "pp_pool": pp_pool,
         "summary": {k: v for k, v in summary.items() if k != "scored"},
         "builds": builds,
         "engine_cache": cache,

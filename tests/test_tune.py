@@ -158,6 +158,40 @@ class TestWireSummary:
         assert got["sparse_over_dense_fp32_ref"] is None
         assert got["dense_fp32_reference_bytes"] == 0
 
+    def test_pipeline_section_golden_diff_vs_costmodel(self):
+        """tools/wire_bytes_report.py's per-plan pipeline section is
+        the one wire owner's output verbatim — golden-diffed per plan
+        against direct pipeline_wire_bytes calls (ISSUE 18
+        satellite)."""
+        from tools.wire_bytes_report import pipeline_plan_section
+
+        rec = dict(schedule="1f1b", microbatches=4, virtual_stages=1,
+                   pinned_stages=None, num_layers=8, model_dim=32,
+                   act_itemsize=4, act_bytes=65536, global_batch=32)
+        got = pipeline_plan_section(rec, num_devices=8)
+        assert got["act_bytes_per_boundary"] == 65536
+        rows = {r["plan"]: r for r in got["plans"]}
+        assert rows and all(r["pp"] > 1 for r in rows.values())
+        from parallax_tpu.tune.search import emittable_plans as ep
+        for plan in ep(8, max_pp=8, pipeline=rec):
+            if plan.pp == 1:
+                continue
+            want = costmodel.pipeline_wire_bytes(
+                65536, 4, plan.pp, plan.virtual_stages,
+                schedule="1f1b", dp=plan.dp, tp=plan.tp)
+            row = rows[plan.describe()]
+            for k in ("per_hop_bytes", "activation_bytes",
+                      "cotangent_bytes", "total_bytes", "ticks",
+                      "bubble_fraction", "microbatches_scheduled"):
+                assert row[k] == want[k], (plan.describe(), k)
+            # 1f1b: the cotangent stream mirrors the activations
+            assert row["cotangent_bytes"] == row["activation_bytes"]
+        # missing act_bytes falls back to the derivable product, same
+        # as costmodel.predict
+        rec2 = dict(rec, act_bytes=None)
+        got2 = pipeline_plan_section(rec2, num_devices=8)
+        assert got2["act_bytes_per_boundary"] == 32 * 32 * 4
+
 
 # -- plan / config validation ---------------------------------------------
 
@@ -471,6 +505,16 @@ def test_mesh_search_end_to_end_vs_exhaustive():
     assert result["n_plans"] >= 3
     assert result["spearman"] >= 0.4, result
     assert result["model_worst_is_measured_worst"], result
+    # the pipeline plan pool (ISSUE 18): the same driver measures a
+    # pp-bearing pool on a pipeline-capable LM — the bubble + wire
+    # pricing must rank the measured pp separations too
+    pool = result["pp_pool"]
+    assert "error" not in pool, pool
+    assert pool["n_plans"] >= 3
+    assert any(r["pp"] > 1 for r in pool["rows"]), pool
+    assert all(r["bubble_fraction"] is not None
+               for r in pool["rows"] if r["pp"] > 1), pool
+    assert pool["spearman"] >= 0.4, pool
     # calibration loop (ISSUE 13): ratios derived from a profiled
     # window of the probe plan, persisted + reloaded, must leave the
     # ranking no worse than the nominal constants' on the SAME
@@ -511,3 +555,375 @@ def test_flight_dump_carries_tune_record(tmp_path, rng):
         assert tune["trials"][0]["measured_ms"] is not None
     finally:
         sess.close()
+
+
+# -- the third mesh axis: (dp x tp x pp) plans (ISSUE 18) -----------------
+
+
+def _pipeline_record(**kw):
+    """A model-declared pipeline capability record (what
+    ``Model.pipeline_info`` + ``inputs_from_engine`` produce)."""
+    rec = dict(schedule="gpipe", microbatches=4, virtual_stages=1,
+               pinned_stages=None, num_layers=8, model_dim=32,
+               act_itemsize=4, act_bytes=1_000_000, global_batch=32)
+    rec.update(kw)
+    return rec
+
+
+class TestPipelineBubbleMath:
+    """Hand-computed tick/bubble accounting — the one owner
+    (costmodel.pipeline_bubble) both the pricing and the
+    wire report consume."""
+
+    def test_gpipe_bubble_hand_computed(self):
+        # S=4 stages, M=4 microbatches: ticks = 4 + 3 = 7
+        b = costmodel.pipeline_bubble(4, 4)
+        assert b["ticks"] == 7
+        assert b["bubble_fraction"] == pytest.approx(3 / 7)
+        assert b["on_chip_scale"] == pytest.approx(7 / 4)
+        # at M % S == 0 the scale is exactly 1/(1 - bubble)
+        assert b["on_chip_scale"] == pytest.approx(
+            1 / (1 - b["bubble_fraction"]))
+
+    def test_interleaving_cuts_the_bubble(self):
+        # V=2 chunks: ticks = 2*4 + 3 = 11, bubble 3/11 < 3/7
+        b1 = costmodel.pipeline_bubble(4, 4, virtual_stages=1)
+        b2 = costmodel.pipeline_bubble(4, 4, virtual_stages=2)
+        assert b2["ticks"] == 11
+        assert b2["bubble_fraction"] == pytest.approx(3 / 11)
+        assert b2["bubble_fraction"] < b1["bubble_fraction"]
+        assert b2["on_chip_scale"] == pytest.approx(11 / 8)
+
+    def test_ragged_interleaved_prices_rounded_microbatches(self):
+        # M=6 is ragged over S=4 at V=2: padded to 8 entries/chunk,
+        # ticks = 2*8 + 3 = 19 over 12 ideal slots — the masked
+        # bubble entries the schedule really executes
+        b = costmodel.pipeline_bubble(6, 4, virtual_stages=2)
+        assert b["microbatches_scheduled"] == 8
+        assert b["ticks"] == 19
+        assert b["on_chip_scale"] == pytest.approx(19 / 12)
+        # V=1 schedules never round
+        assert costmodel.pipeline_bubble(6, 4)[
+            "microbatches_scheduled"] == 6
+
+    def test_bubble_refuses_degenerate_inputs(self):
+        with pytest.raises(ValueError, match="M, S, V"):
+            costmodel.pipeline_bubble(0, 4)
+        with pytest.raises(ValueError, match="M, S, V"):
+            costmodel.pipeline_bubble(4, 4, virtual_stages=0)
+
+    def test_wire_bytes_hand_computed(self):
+        # act 1000 B global, M=4, S=4, dp=2: one hop carries one
+        # microbatch of one replica row -> 1000/(4*2) = 125 B; every
+        # tick every device ppermutes -> 125 * (2*1*4) * 7 = 7000 B
+        g = costmodel.pipeline_wire_bytes(1000.0, 4, 4, dp=2,
+                                          schedule="gpipe")
+        assert g["per_hop_bytes"] == pytest.approx(125.0)
+        assert g["ticks"] == 7
+        assert g["activation_bytes"] == pytest.approx(7000.0)
+        assert g["cotangent_bytes"] == 0.0
+        assert g["total_bytes"] == pytest.approx(7000.0)
+
+    def test_1f1b_cotangent_doubles_the_stream(self):
+        g = costmodel.pipeline_wire_bytes(1000.0, 4, 4, dp=2,
+                                          schedule="gpipe")
+        f = costmodel.pipeline_wire_bytes(1000.0, 4, 4, dp=2,
+                                          schedule="1f1b")
+        assert f["cotangent_bytes"] == pytest.approx(
+            f["activation_bytes"])
+        assert f["total_bytes"] == pytest.approx(
+            2 * g["total_bytes"])
+
+    def test_balanced_stage_cut_hand_computed(self):
+        # symmetric hot ends: the DP finds the even 6/6 split
+        cut, sums = costmodel.balanced_stage_cut(
+            [4, 1, 1, 1, 1, 4], 2)
+        assert cut == [0, 3, 6]
+        assert sums == [6.0, 6.0]
+        # uniform layers split evenly
+        cut, sums = costmodel.balanced_stage_cut([1.0] * 8, 4)
+        assert cut == [0, 2, 4, 6, 8]
+        assert sums == [2.0] * 4
+        # a hot middle layer is isolated with its cheapest neighbors
+        cut, sums = costmodel.balanced_stage_cut(
+            [1, 1, 5, 1, 1, 1], 2)
+        assert cut == [0, 3, 6]
+        assert sums == [7.0, 3.0]
+
+    def test_stage_cut_refuses_more_stages_than_layers(self):
+        with pytest.raises(ValueError, match="stages"):
+            costmodel.balanced_stage_cut([1.0], 2)
+
+
+class TestPipelinePlanPricing:
+    def test_pp_scales_on_chip_and_adds_wire(self):
+        base = costmodel.predict(Plan(8, 1, "HYBRID"), _inputs())
+        pp = costmodel.predict(
+            Plan(4, 1, "HYBRID", pp=2, microbatches=4),
+            _inputs(pipeline=_pipeline_record()))
+        # S=2, M=4: scale (4+1)/4 = 1.25; uniform layers -> no
+        # imbalance penalty
+        assert pp.terms["compute_s"] == pytest.approx(
+            base.terms["compute_s"] * 1.25)
+        assert pp.terms["hbm_s"] == pytest.approx(
+            base.terms["hbm_s"] * 1.25)
+        want = costmodel.pipeline_wire_bytes(
+            1_000_000, 4, 2, dp=4, schedule="gpipe")["total_bytes"]
+        assert pp.terms["wire_pp_s"] == pytest.approx(
+            want / (8 * 1e10))
+        # pp=1 plans never grow pipeline terms — byte-identical 2-D
+        # breakdown
+        assert "wire_pp_s" not in base.terms
+        assert "pp_bubble_s" not in base.terms
+        assert base.pipeline is None
+
+    def test_pricing_record_explains_the_cut(self):
+        pp = costmodel.predict(
+            Plan(4, 1, "HYBRID", pp=2, microbatches=4),
+            _inputs(pipeline=_pipeline_record()))
+        rec = pp.pipeline
+        assert rec["pp"] == 2
+        assert rec["bubble_fraction"] == pytest.approx(0.2)
+        assert rec["stage_cut"] == [0, 4, 8]  # 8 uniform layers
+        assert rec["imbalance"] == pytest.approx(1.0)
+        d = pp.as_dict()
+        assert d["pp"] == 2
+        assert d["pipeline"]["stage_cut"] == [0, 4, 8]
+
+    def test_declared_layer_costs_scale_the_imbalance(self):
+        plan = Plan(4, 1, "HYBRID", pp=2, microbatches=4)
+        even = costmodel.predict(
+            plan, _inputs(pipeline=_pipeline_record(num_layers=6)))
+        hot = costmodel.predict(
+            plan, _inputs(pipeline=_pipeline_record(
+                num_layers=6, layer_costs=[1, 1, 5, 1, 1, 1])))
+        # cut [1,1,5 | 1,1,1]: imbalance = 2 * 7 / 10 = 1.4
+        assert hot.pipeline["imbalance"] == pytest.approx(1.4)
+        assert hot.terms["compute_s"] == pytest.approx(
+            even.terms["compute_s"] * 1.4)
+
+    def test_1f1b_schedule_doubles_pp_wire(self):
+        plan = Plan(4, 1, "HYBRID", pp=2, microbatches=4)
+        g = costmodel.predict(
+            plan, _inputs(pipeline=_pipeline_record()))
+        f = costmodel.predict(
+            plan, _inputs(pipeline=_pipeline_record(schedule="1f1b")))
+        assert f.terms["wire_pp_s"] == pytest.approx(
+            2 * g.terms["wire_pp_s"])
+
+    def test_pp_without_pipeline_record_refuses(self):
+        with pytest.raises(ValueError, match="pipeline"):
+            costmodel.predict(Plan(4, 1, "HYBRID", pp=2), _inputs())
+
+    def test_calibration_folds_pp_wire_into_wire_term(self):
+        from parallax_tpu.tune import calibrate
+        pp = costmodel.predict(
+            Plan(4, 1, "HYBRID", pp=2, microbatches=4),
+            _inputs(pipeline=_pipeline_record()))
+        terms = calibrate.predicted_terms_from_cost(pp.terms)
+        wire_wo = calibrate.predicted_terms_from_cost(
+            {k: v for k, v in pp.terms.items() if k != "wire_pp_s"})
+        assert terms["wire"] == pytest.approx(
+            wire_wo["wire"] + pp.terms["wire_pp_s"])
+
+
+class TestPipelinePlanValidation:
+    def test_plan_refuses_nonpositive_pp(self):
+        with pytest.raises(ValueError, match="pp"):
+            Plan(1, 8, pp=0)
+
+    def test_plan_product_covers_all_three_axes(self):
+        with pytest.raises(ValueError, match="dp\\*tp\\*pp"):
+            Plan(4, 1, "HYBRID", pp=2).validate_for(4)
+        Plan(4, 1, "HYBRID", pp=2).validate_for(8)  # ok
+
+    def test_schedule_knobs_require_pp(self):
+        with pytest.raises(ValueError, match="pp > 1"):
+            Plan(1, 8, virtual_stages=2)
+        with pytest.raises(ValueError, match="pp > 1"):
+            Plan(1, 8, microbatches=4)
+
+    def test_mesh_shape_is_legacy_2_tuple_at_pp1(self):
+        assert Plan(8, 1).mesh_shape() == (8, 1)
+        assert Plan(4, 1, "HYBRID", pp=2).mesh_shape() == (4, 1, 2)
+
+    def test_describe_and_cache_key_distinguish_pp(self):
+        assert Plan(8, 1, "HYBRID").describe() == "dp8xtp1/HYBRID"
+        p = Plan(4, 1, "HYBRID", pp=2, microbatches=4)
+        assert p.describe() == "dp4xtp1xpp2/HYBRID+m4"
+        v = Plan(4, 1, "HYBRID", pp=2, virtual_stages=2,
+                 microbatches=4)
+        assert v.describe() == "dp4xtp1xpp2/HYBRID+v2+m4"
+        keys = {Plan(8, 1, "HYBRID").cache_key(), p.cache_key(),
+                v.cache_key()}
+        assert len(keys) == 3
+
+    def test_tune_config_refuses_bad_max_pp(self):
+        with pytest.raises(ValueError, match="max_pp"):
+            parallax.TuneConfig(max_pp=0)
+
+
+class TestPipelineEnumeration:
+    def test_pp1_block_is_byte_identical_to_2d_space(self):
+        """The load-bearing zero-behavior-change pin: with the pp
+        dimension open, the pp=1 sub-list is EXACTLY yesterday's 2-D
+        list, element for element."""
+        with_pp = emittable_plans(8, max_pp=8,
+                                  pipeline=_pipeline_record())
+        assert [p for p in with_pp if p.pp == 1] == emittable_plans(8)
+        full = enumerate_plans(8, max_pp=8,
+                               pipeline=_pipeline_record())
+        assert [p for p in full if p.pp == 1] == enumerate_plans(8)
+
+    def test_max_pp_without_capability_record_is_a_noop(self):
+        assert emittable_plans(8, max_pp=8) == emittable_plans(8)
+        assert enumerate_plans(8, max_pp=8) == enumerate_plans(8)
+
+    def test_pp_values_respect_divisibility(self):
+        # 8 devices, 8 layers: pp in {2, 4, 8} all divide both; a
+        # 6-layer model excludes pp=4 and pp=8 (stage reshape ragged)
+        plans = emittable_plans(8, max_pp=8,
+                                pipeline=_pipeline_record())
+        assert {p.pp for p in plans} == {1, 2, 4, 8}
+        plans6 = emittable_plans(
+            8, max_pp=8, pipeline=_pipeline_record(num_layers=6))
+        assert {p.pp for p in plans6} == {1, 2}
+
+    def test_max_pp_caps_the_lattice(self):
+        plans = emittable_plans(8, max_pp=2,
+                                pipeline=_pipeline_record())
+        assert {p.pp for p in plans} == {1, 2}
+
+    def test_pinned_stages_pin_pp_under_interleaving(self):
+        # a V>1 storage order is baked for one stage count: only that
+        # pp enumerates
+        plans = emittable_plans(8, max_pp=8, pipeline=_pipeline_record(
+            virtual_stages=2, pinned_stages=2))
+        assert {p.pp for p in plans} == {1, 2}
+        assert all(p.virtual_stages == 2
+                   for p in plans if p.pp > 1)
+
+    def test_microbatch_divisibility_prunes_inadmissible_dp(self):
+        # global_batch=4, M=4: dp must satisfy (4/dp) % 4 == 0 -> only
+        # dp=1 survives per pp block
+        plans = emittable_plans(
+            8, max_pp=2, pipeline=_pipeline_record(global_batch=4))
+        assert all(p.dp == 1 for p in plans if p.pp > 1)
+
+    def test_each_pp_block_keeps_one_replicated_canonical(self):
+        plans = emittable_plans(8, max_pp=8,
+                                pipeline=_pipeline_record())
+        for pp in (1, 2, 4, 8):
+            tp1 = [p for p in plans if p.pp == pp and p.tp == 1]
+            assert len(tp1) == 1, (pp, tp1)
+
+    def test_search_summary_reports_pp_gate_state(self):
+        ms = MeshSearch(8, parallax.TuneConfig(top_k=2, max_pp=4),
+                        Plan(1, 8))
+        ms.begin(_inputs(pipeline=_pipeline_record()))
+        s = ms.summary()
+        assert s["max_pp"] == 4
+        assert s["pipeline_capable"] is True
+        assert any(pc["pp"] > 1 for pc in s["scored"])
+        # without the record the same config stays 2-D and says so
+        ms2 = MeshSearch(8, parallax.TuneConfig(top_k=2, max_pp=4),
+                         Plan(1, 8))
+        ms2.begin(_inputs())
+        s2 = ms2.summary()
+        assert s2["pipeline_capable"] is False
+        assert all(pc["pp"] == 1 for pc in s2["scored"])
+
+
+def _pipeline_lc_model(num_layers=4, microbatches=2,
+                       schedule="gpipe"):
+    import jax.numpy as jnp
+
+    from parallax_tpu.models import long_context as lc
+
+    cfg = lc.tiny_config(parallelism="pipeline",
+                         num_layers=num_layers,
+                         num_microbatches=microbatches,
+                         pipeline_schedule=schedule,
+                         compute_dtype=jnp.float32)
+    return lc.build_model(cfg), cfg
+
+
+class TestPipelineEngineCache:
+    def test_pp_plan_keys_apart_and_routes_to_3_axis_mesh(self, rng):
+        """ISSUE 18 cache pin (same shape as the ISSUE 10 one): a pp
+        plan must never collide with its 2-D peer — the key carries
+        the full 3-tuple + schedule knobs — and the pp engine really
+        runs on a 3-axis mesh."""
+        from parallax_tpu.core import mesh as mesh_lib
+        from parallax_tpu.models import long_context as lc
+
+        model, cfg = _pipeline_lc_model()
+        sess, *_ = parallax.parallel_run(
+            model,
+            parallax_config=parallax.Config(run_option="HYBRID",
+                                            search_partitions=False,
+                                            eager_fetch=True),
+            num_partitions=1)
+        try:
+            feed = lc.make_batch(rng, 8, 16, cfg.vocab_size)
+            float(sess.run("loss", feed_dict=feed))
+            e_flat = sess.engine
+            assert sess.plan.describe() == "dp8xtp1/HYBRID"
+            assert mesh_lib.AXIS_PIPE not in e_flat.mesh.axis_names
+            example = sess._last_example_batch
+            builds = sess.metrics.counter("engine.builds").value
+            pp_plan = Plan(4, 1, "HYBRID", pp=2, microbatches=2)
+            sess._build_engine(example, pp_plan)
+            e_pp = sess.engine
+            assert e_pp is not e_flat
+            assert mesh_lib.AXIS_PIPE in e_pp.mesh.axis_names
+            assert dict(zip(e_pp.mesh.axis_names,
+                            e_pp.mesh.devices.shape)) == {
+                "repl": 4, "shard": 1, "pipe": 2}
+            assert sess.metrics.counter("engine.builds").value == \
+                builds + 1
+            # exact re-request of either plan: cache hits, no build
+            hits0 = sess.compile_stats()["engine_cache"]["hits"]
+            sess._build_engine(example, Plan(8, 1, "HYBRID"))
+            assert sess.engine is e_flat
+            sess._build_engine(example, pp_plan)
+            assert sess.engine is e_pp
+            assert sess.compile_stats()["engine_cache"]["hits"] == \
+                hits0 + 2
+            assert sess.metrics.counter("engine.builds").value == \
+                builds + 1
+        finally:
+            sess.close()
+
+
+def test_oom_unlock_pp_plan_survives_preflight():
+    """The PR's headline proof (ISSUE 18): a model whose compiled
+    peak REFUSES every 2-D plan still trains — the preflight
+    backfills the shortlist from the 3-D lattice and a pp>1 plan
+    wins, with the refusal, the stage cut and the bubble all in the
+    decision record. Runs in an isolated driver process
+    (tests/oom_unlock_driver.py): an in-process multi-mesh search is
+    exactly the workload that intermittently hard-crashes this
+    XLA:CPU toolchain — isolation makes a crash cost one retry,
+    never the pytest process."""
+    r = _run_driver_json(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__),
+                      "oom_unlock_driver.py")])
+    assert r["settled"], "search should settle"
+    # the whole 2-D space (one replicated AR plan) was refused...
+    assert r["pruned_oom"] >= 1, r
+    assert "dp8xtp1/AR" in r["refused"], r
+    # ...and the winner is a pipeline plan that could not have been
+    # emitted before the third axis existed
+    assert r["winner"]["pp"] > 1, r["winner"]
+    assert r["winner"]["plan"] not in r["refused"]
+    assert r["winner"]["bubble_fraction"] is not None
+    assert r["session_plan_pp"] > 1
+    assert "pipe" in r["mesh_axes"]
+    # the scored record explains the cut
+    assert r["winner_stage_cut"] is not None
+    assert r["winner_wire_pp_s"] is not None
+    # the proof rides the tune_decision flight artifact
+    assert r["artifact_pruned_oom"] >= 1
+    assert r["artifact_winner_pp"] > 1

@@ -284,6 +284,14 @@ SECONDARY_GATES = (
     ("numerics.drift.paged_attn.accuracy", False),
     ("numerics.drift.paged_attn.accuracy", True),
     ("numerics.consume_us", False),
+    # pipeline third axis (ISSUE 18, bench "tune.pp_trial" sub-block):
+    # a pp>1 trial row's predicted-over-measured, gated in BOTH
+    # directions — the same two-row two-sided drift pattern as the 2-D
+    # tune gate above (the absolute is CPU-relative on the CPU rig; a
+    # drifting ratio means the bubble + inter-stage-transfer pricing
+    # and the measured 1F1B/GPipe schedule are coming apart)
+    ("tune.pp_trial.predicted_over_measured", False),
+    ("tune.pp_trial.predicted_over_measured", True),
 )
 
 

@@ -148,6 +148,71 @@ def flagship_accounting(n_chips: int = 8, batch_per_chip: int = 128,
     }
 
 
+def pipeline_plan_section(pipeline: dict, num_devices: int = 8,
+                          max_pp=None):
+    """Per-plan inter-stage wire accounting for every pp > 1 plan the
+    tuner can emit for a model with the given pipeline capability
+    record (ISSUE 18 satellite). Pure math off the ONE wire owner
+    (tune/costmodel.pipeline_wire_bytes / pipeline_bubble) — the same
+    figures ``predict`` folds into ``wire_pp_s``, reported here as raw
+    bytes so the report stays execution-free like the rest of the
+    accounting."""
+    from parallax_tpu.tune import costmodel
+    from parallax_tpu.tune.search import emittable_plans
+
+    act = float(pipeline.get("act_bytes") or 0.0)
+    if not act:
+        act = (float(pipeline.get("global_batch") or 0)
+               * float(pipeline.get("model_dim") or 0)
+               * float(pipeline.get("act_itemsize") or 4))
+    schedule = str(pipeline.get("schedule") or "gpipe")
+    rows = []
+    for plan in emittable_plans(num_devices,
+                                max_pp=max_pp or num_devices,
+                                pipeline=pipeline):
+        if plan.pp == 1:
+            continue
+        V = max(int(plan.virtual_stages), 1)
+        M = int(plan.microbatches
+                or pipeline.get("microbatches") or 1)
+        w = costmodel.pipeline_wire_bytes(
+            act, M, plan.pp, V, schedule=schedule,
+            dp=plan.dp, tp=plan.tp)
+        rows.append({
+            "plan": plan.describe(),
+            "pp": plan.pp,
+            "schedule": schedule,
+            "per_hop_bytes": w["per_hop_bytes"],
+            "activation_bytes": w["activation_bytes"],
+            "cotangent_bytes": w["cotangent_bytes"],
+            "total_bytes": w["total_bytes"],
+            "ticks": w["ticks"],
+            "bubble_fraction": w["bubble_fraction"],
+            "microbatches_scheduled": w["microbatches_scheduled"],
+        })
+    return {
+        "act_bytes_per_boundary": act,
+        "num_devices": num_devices,
+        "plans": rows,
+    }
+
+
+def _demo_pipeline_record():
+    """The pipeline capability record of the tiny pipeline LM the rest
+    of the tooling (bench tune block, mesh_search_driver pp pool)
+    exercises — so --pipeline reports the same plan pool they
+    measure."""
+    from parallax_tpu.models import long_context as lc
+    cfg = lc.tiny_config(parallelism="pipeline", num_layers=8,
+                         num_microbatches=4)
+    info = dict(lc.build_model(cfg).pipeline_info)
+    # the model declares the schedule; the batch the drivers feed it
+    # (B=32, T=16) sets the boundary activation: tokens x dim x 4B
+    info["global_batch"] = 32
+    info["act_bytes"] = 32 * 16 * cfg.model_dim * 4
+    return info
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None,
@@ -160,6 +225,9 @@ def main():
                     help="per-device unique-id slots: an int, or 'auto' "
                          "for per-table capacities from the measured "
                          "distinct-id profile")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="append the per-plan pipeline wire section "
+                         "(inter-stage bytes + bubble per pp>1 plan)")
     args = ap.parse_args()
     cap = args.dedup_capacity
     if cap is not None and cap != "auto":
@@ -167,6 +235,9 @@ def main():
     result = flagship_accounting(args.n_chips, args.batch_per_chip,
                                  table_dtype=args.table_dtype,
                                  dedup_capacity=cap)
+    if args.pipeline:
+        result["pipeline_plans"] = pipeline_plan_section(
+            _demo_pipeline_record(), num_devices=args.n_chips)
     line = json.dumps(result)
     print(line)
     if args.out:
