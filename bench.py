@@ -602,6 +602,93 @@ def worker_main():
                     "ok": not pviol,
                     "violations": pviol[:3] or None,
                 }
+            # Disaggregation A/B block (ISSUE 19): colocated ServeFleet
+            # vs DisaggFleet (prefill pool -> wire transfer -> decode
+            # pool) replaying the SAME mixed-regime request stream —
+            # long-prefill/short-decode mixed with short-prefill/long-
+            # decode, the traffic shape that pulls a colocated replica
+            # in opposite directions. serve.disagg.ttft_ms_p99 and
+            # serve.disagg.tokens_per_sec are secondary-gated
+            # (tools/check_regression.py); no BENCH_VERSION bump
+            # (additive block, gates skip when absent).
+            # PARALLAX_BENCH_DISAGG=0 skips.
+            if os.environ.get("PARALLAX_BENCH_DISAGG", "1") != "0":
+                from parallax_tpu.serve import (DisaggFleet,
+                                                FleetConfig,
+                                                ServeFleet)
+                mk = loadgen.demo_disagg_rig(slots=4)
+                dfeed, dmnt = loadgen.mixed_regime_feed(vocab=64)
+                n_req = 24
+
+                colo = ServeFleet(mk, config=FleetConfig(
+                    num_replicas=2, min_replicas=1))
+                try:
+                    # unmeasured warmup drains: first-touch lazy init
+                    # on each arm's serving path would otherwise land
+                    # a ~1s bimodal spike in the gated p99
+                    for i in range(2):
+                        colo.submit(dfeed(i), max_new_tokens=dmnt(i)
+                                    ).result(timeout=120)
+                    crep = loadgen.run_load(
+                        colo, dfeed, n_requests=n_req, concurrency=4,
+                        max_new_tokens=dmnt)
+                finally:
+                    colo.close()
+
+                dis = DisaggFleet(
+                    mk, mk,
+                    prefill_config=FleetConfig(num_replicas=1,
+                                               min_replicas=1),
+                    decode_config=FleetConfig(num_replicas=1,
+                                              min_replicas=1))
+                try:
+                    for i in range(2):
+                        dis.submit(dfeed(i), max_new_tokens=dmnt(i)
+                                   ).result(timeout=120)
+                    drep = loadgen.run_load(
+                        dis, dfeed, n_requests=n_req, concurrency=4,
+                        max_new_tokens=dmnt)
+                    dsnap = dis.metrics.snapshot()
+                    drecomp = dis.recompiles()
+                finally:
+                    dis.close()
+
+                def _arm(rep):
+                    return {
+                        "completed": rep["completed"],
+                        "tokens_per_sec": rep["tokens_per_sec"],
+                        "ttft_ms_p50": rep["ttft_ms"]["p50"],
+                        "ttft_ms_p99": rep["ttft_ms"]["p99"],
+                    }
+
+                tms = dsnap.get("serve.disagg.transfer_ms") or {}
+                pms = dsnap.get("serve.disagg.prefill_ms") or {}
+                serve_snap["disagg"] = {
+                    "colocated": _arm(crep),
+                    "disaggregated": _arm(drep),
+                    # gate-addressable copies of the disaggregated
+                    # arm: serve.disagg.ttft_ms_p99 and
+                    # serve.disagg.tokens_per_sec resolve here
+                    "ttft_ms_p99": drep["ttft_ms"]["p99"],
+                    "tokens_per_sec": drep["tokens_per_sec"],
+                    "transfers": dsnap.get("serve.disagg.transfers"),
+                    "transfer_bytes": dsnap.get(
+                        "serve.disagg.transfer_bytes"),
+                    "transfer_ms_p50": tms.get("p50"),
+                    "transfer_ms_mean": tms.get("mean"),
+                    "prefill_ms_p50": pms.get("p50"),
+                    "prefill_fallbacks": dsnap.get(
+                        "serve.disagg.prefill_fallbacks"),
+                    "recompiles": drecomp,
+                    # the caveat lives IN the artifact so a reader of
+                    # bench.json sees it without the docs
+                    "note": ("single-process CPU arms: the 'wire' is "
+                             "a host memcpy and both pools share one "
+                             "machine, so the colocated-vs-disagg "
+                             "verdict does not transfer to TPUs; "
+                             "cross-round drift of the gated keys is "
+                             "the signal, not the A/B winner"),
+                }
         except Exception as e:
             print(f"# serve bench failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)

@@ -278,3 +278,69 @@ def make_batch(rng: np.random.Generator, batch_size: int, num_steps: int,
     y = np.roll(x, -1, axis=1)
     return {"x": x.astype(np.int32), "y": y.astype(np.int32),
             "w": np.ones((batch_size, num_steps), np.float32)}
+
+
+# ----- serving decode ------------------------------------------------------
+# Incremental decode for serve/adapters.LM1BDecodeProgram: the cache is
+# the LSTM carry itself ([S, H] cell + [S, P] projected hidden per
+# slot), not a KV buffer — the adapter that proves the DecodeProgram
+# contract isn't transformer-shaped. Greedy decode uses the FULL
+# softmax projection (the sampled softmax is a training-only loss).
+
+
+def _lstm_serve_weights(cfg: LM1BConfig, params):
+    cdt = cfg.compute_dtype
+    lstm = params["lstm"]
+    return (lstm["w"].astype(cdt), lstm["b"].astype(cdt),
+            lstm["w_proj"].astype(cdt))
+
+
+def _lstm_prefill(cfg: LM1BConfig, params, ids, pad_id=0):
+    """Run the recurrence over the prompt EXCEPT its last token — the
+    first decode step consumes that one (double-stepping it is the
+    classic off-by-one). ``ids`` [1, Ts] padded with ``pad_id``; a
+    gated scan (valid = j < t0 - 1) leaves the carry untouched on
+    padded rows. Returns (c [1, H], h [1, P], base [1], first [1])."""
+    cdt = cfg.compute_dtype
+    w, b, w_proj = _lstm_serve_weights(cfg, params)
+    B, Ts = ids.shape
+    emb = emb_ops.embedding_lookup(params["emb"], ids).astype(cdt)
+    t0 = jnp.sum((ids[0] != pad_id).astype(jnp.int32))
+    c0 = jnp.zeros((B, cfg.hidden_dim), cdt)
+    h0 = jnp.zeros((B, cfg.proj_dim), cdt)
+
+    def cell(carry, inp):
+        c, h = carry
+        x_t, valid = inp
+        zx = jnp.concatenate([x_t, h], axis=-1)
+        gates = zx @ w + b
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c2 = (jax.nn.sigmoid(f + 1.0) * c
+              + jax.nn.sigmoid(i) * jnp.tanh(g))
+        h2 = (jax.nn.sigmoid(o) * jnp.tanh(c2)) @ w_proj
+        return (jnp.where(valid, c2, c), jnp.where(valid, h2, h)), None
+
+    valid = jnp.arange(Ts) < (t0 - 1)
+    (c, h), _ = jax.lax.scan(cell, (c0, h0),
+                             (jnp.swapaxes(emb, 0, 1), valid))
+    base = (t0 - 1).astype(jnp.int32)
+    first = jnp.take(ids[0], base, mode="clip").astype(jnp.int32)
+    return c, h, base[None], first[None]
+
+
+def _lstm_decode_step(cfg: LM1BConfig, params, tok, c, h):
+    """One batched greedy-decode step: ``tok`` [S] is each slot's
+    current token; returns (logits [S, padded_vocab] f32, c, h). Every
+    op is row-wise, so co-batched slots decode independently."""
+    cdt = cfg.compute_dtype
+    w, b, w_proj = _lstm_serve_weights(cfg, params)
+    x = emb_ops.embedding_lookup(params["emb"], tok).astype(cdt)
+    zx = jnp.concatenate([x, h], axis=-1)
+    gates = zx @ w + b
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = (jax.nn.sigmoid(o) * jnp.tanh(c)) @ w_proj
+    logits = (h.astype(jnp.float32)
+              @ params["softmax_w"].astype(jnp.float32).T
+              + params["softmax_b"].astype(jnp.float32)[:, 0][None, :])
+    return emb_ops.mask_padded_logits(logits, cfg.vocab_size), c, h

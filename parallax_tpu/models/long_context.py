@@ -509,3 +509,180 @@ def make_batch(rng: np.random.Generator, batch_size: int, seq_len: int,
                vocab_size: int):
     return {"ids": rng.integers(1, vocab_size,
                                 (batch_size, seq_len)).astype(np.int32)}
+
+
+# ----- KV-cached serving decode -------------------------------------------
+# Incremental decode for the data-path block math above, consumed by
+# serve/adapters.CausalLMDecodeProgram. Module-level (not closed over
+# build_model) so the adapter can jit a fixed signature set once and
+# serve with zero recompiles. The prompt prefill runs the full forward
+# over the padded prompt buffer and CAPTURES each layer's K/V
+# projections; the cached step then computes one position at a time
+# against the stored cache — scatter-then-attend, the
+# models/nmt._decode_tokens_cached shape, but pre-LN and decoder-only.
+# Serve-vs-standalone bit-identity holds because both paths run these
+# exact functions (see serve/adapters.standalone_greedy).
+
+
+def _serve_layer_norm(x, p):
+    m = jnp.mean(x, -1, keepdims=True)
+    v = jnp.var(x, -1, keepdims=True)
+    return ((x - m) * jax.lax.rsqrt(v + 1e-6) * p["s"].astype(x.dtype)
+            + p["b"].astype(x.dtype))
+
+
+def _serve_attention(q, k, v, mask, num_heads):
+    """Masked multi-head attention over a dense/gathered KV buffer —
+    the serve decode core. Same scale and fp32-accumulation convention
+    as models/nmt._attention (which the fused paged kernel
+    token-matches), so the einsum and kernel executors agree."""
+    B, Tq, D = q.shape
+    Tk = k.shape[1]
+    h = num_heads
+    hd = D // h
+
+    def split(x, T):
+        return x.reshape(B, T, h, hd).transpose(0, 2, 1, 3)
+
+    qh, kh, vh = split(q, Tq), split(k, Tk), split(v, Tk)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) / np.sqrt(hd)
+    scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    probs = probs.astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return out.transpose(0, 2, 1, 3).reshape(B, Tq, D)
+
+
+def _prefill_embed(cfg: LongContextConfig, params, ids):
+    """Prefill chunk 0: embedding + positional add over the padded
+    prompt buffer ``ids`` [1, Ts]; allocates the K/V capture stacks."""
+    dt = cfg.compute_dtype
+    Ts = ids.shape[1]
+    x = (emb_ops.embedding_lookup(params["emb"], ids).astype(dt)
+         + params["pos"][:Ts].astype(dt)[None])
+    z = jnp.zeros((cfg.num_layers, 1, Ts, cfg.model_dim), dt)
+    return {"x": x, "pk": z, "pv": z, "ids": ids}
+
+
+def _prefill_layers(cfg: LongContextConfig, params, carry, lo, hi):
+    """Prefill layers ``[lo, hi)``: capture each layer's prompt K/V
+    projections, then apply the pre-LN block (causal). Padded rows
+    (j >= t0) compute garbage K/V — the serve insert routes them to the
+    OOB sentinel so they never reach a page."""
+    dt = cfg.compute_dtype
+    x, pk, pv = carry["x"], carry["pk"], carry["pv"]
+    B, Ts, D = x.shape
+    Hn = cfg.num_heads
+
+    def heads(z):
+        return z.reshape(B, Ts, Hn, D // Hn)
+
+    for i in range(lo, hi):
+        p = params["blocks"][i]
+        h = _serve_layer_norm(x, p["ln1"])
+        q, k, v = jnp.split(h @ p["wqkv"].astype(dt), 3, -1)
+        pk = pk.at[i].set(k)
+        pv = pv.at[i].set(v)
+        out = full_attention_reference(heads(q), heads(k), heads(v),
+                                       causal=True)
+        x = x + out.reshape(B, Ts, D) @ p["wo"].astype(dt)
+        h2 = _serve_layer_norm(x, p["ln2"])
+        x = x + (jax.nn.relu(h2 @ p["w1"].astype(dt))
+                 @ p["w2"].astype(dt))
+    return {"x": x, "pk": pk, "pv": pv, "ids": carry["ids"]}
+
+
+def _prefill_finish(carry, pad_id=0):
+    """Final prefill chunk: the per-request decode state. ``base`` is
+    the position of the LAST prompt token (t0 - 1): decode step 0
+    consumes that token (``first``) at position ``base`` and emits the
+    first generated token, so step t writes position base + t."""
+    ids = carry["ids"]
+    t0 = jnp.sum((ids[0] != pad_id).astype(jnp.int32))
+    base = (t0 - 1).astype(jnp.int32)
+    first = jnp.take(ids[0], base, mode="clip").astype(jnp.int32)
+    return {"pk": carry["pk"], "pv": carry["pv"],
+            "base": base[None], "first": first[None]}
+
+
+def _decode_step_cached(cfg: LongContextConfig, params, tok, t, base,
+                        first, kc, vc, pages=None, page_size=None,
+                        attn_impl=None):
+    """One batched cached decoder step: ``tok``/``t``/``base``/``first``
+    are [S] per-slot rows; returns (logits [S, V] f32, kc, vc). Step 0
+    swaps in ``first`` (the last prompt token) for the scheduler-fed
+    BOS; position = base + t. ``pages`` [S, P] selects the paged pool
+    layout [L, pool_pages, page_size, D] (dense: [L, S, Tbuf, D]);
+    ``attn_impl`` routes the paged executor exactly as in
+    models/nmt._decode_tokens_cached — the PR 16 kernel serves this
+    adapter unchanged. Row-wise math only: slots are independent."""
+    dt = cfg.compute_dtype
+    D = cfg.model_dim
+    S = tok.shape[0]
+    paged = pages is not None
+    if paged:
+        # lazy: ops -> models would be circular the other way round
+        from parallax_tpu.ops import pallas_paged_attention as _ppa
+        pool, ps = kc.shape[1], int(page_size)
+        Tbuf = pages.shape[1] * ps
+        impl = _ppa.resolve_impl(
+            attn_impl, G=1, D=D, page_size=ps,
+            num_heads=cfg.num_heads,
+            itemsize=jnp.dtype(dt).itemsize)
+    else:
+        Tbuf = kc.shape[2]
+        rows = jnp.arange(S)
+    tok_eff = jnp.where(t == 0, first, tok)
+    pos = (base + t)[:, None]                                # [S, 1]
+    # clip: a slot at its cap may address one position past the buffer
+    # before it retires host-side; the output is discarded but must
+    # stay finite
+    pos_emb = jnp.take(params["pos"].astype(dt), pos, axis=0,
+                       mode="clip")                          # [S, 1, D]
+    x = (emb_ops.embedding_lookup(params["emb"],
+                                  tok_eff[:, None]).astype(dt)
+         + pos_emb)                                          # [S, 1, D]
+    mask = (jnp.arange(Tbuf)[None, :] <= pos)[:, None, None, :]
+    if paged:
+        pg, off = _ppa.sentinel_write_coords(pages, pos, ps, pool)
+    for i, p in enumerate(params["blocks"]):
+        h = _serve_layer_norm(x, p["ln1"])
+        q, k_t, v_t = jnp.split(h @ p["wqkv"].astype(dt), 3, -1)
+        if paged:
+            kc = kc.at[i, pg, off].set(k_t, mode="drop")
+            vc = vc.at[i, pg, off].set(v_t, mode="drop")
+            if impl == "kernel":
+                y = _ppa.paged_decode_attention(
+                    q, kc[i], vc[i], pages, pos,
+                    num_heads=cfg.num_heads, page_size=ps,
+                    impl="kernel")
+            else:
+                k_all = _ppa.paged_gather(kc[i], pages)
+                v_all = _ppa.paged_gather(vc[i], pages)
+                y = _serve_attention(q, k_all, v_all, mask,
+                                     cfg.num_heads)
+        else:
+            kc = kc.at[i, rows[:, None], pos].set(k_t, mode="drop")
+            vc = vc.at[i, rows[:, None], pos].set(v_t, mode="drop")
+            y = _serve_attention(q, kc[i], vc[i], mask, cfg.num_heads)
+        x = x + y @ p["wo"].astype(dt)
+        h2 = _serve_layer_norm(x, p["ln2"])
+        x = x + (jax.nn.relu(h2 @ p["w1"].astype(dt))
+                 @ p["w2"].astype(dt))
+    logits = x[:, 0].astype(jnp.float32) @ params["out_w"]
+    return logits, kc, vc
+
+
+def _init_serve_self_cache(cfg: LongContextConfig, batch: int,
+                           max_len: int):
+    z = jnp.zeros((cfg.num_layers, batch, max_len, cfg.model_dim),
+                  cfg.compute_dtype)
+    return z, z
+
+
+def _init_serve_paged_cache(cfg: LongContextConfig, pool_pages: int,
+                            page_size: int):
+    z = jnp.zeros((cfg.num_layers, pool_pages, page_size,
+                   cfg.model_dim), cfg.compute_dtype)
+    return z, z

@@ -157,3 +157,136 @@ def make_batch(rng: np.random.Generator, batch_size: int, seq_len: int,
                vocab_size: int):
     return {"ids": rng.integers(1, vocab_size,
                                 (batch_size, seq_len)).astype(np.int32)}
+
+
+# ----- KV-cached serving decode -------------------------------------------
+# Incremental decode for the post-LN switch-MoE blocks above, consumed
+# by serve/adapters.MoeLMDecodeProgram. Same construction as
+# models/long_context's serve section (whose attention/LN helpers this
+# reuses — identical math), but: attention consumes the RAW block input
+# (post-LN residual order), and each block's MLP is the switch MoE.
+# Without a mesh, ops/moe.switch_moe takes the dense per-token expert
+# path — row-wise with no capacity drops, so slots stay independent and
+# exact-under-greedy holds. Under a live mesh the capacity-bounded
+# all_to_all dispatch is NOT row-independent (a co-batched slot can
+# displace another's token at capacity) — documented serving caveat.
+
+from parallax_tpu.models.long_context import (_prefill_finish,  # noqa: E402
+                                              _serve_attention,
+                                              _serve_layer_norm)
+
+
+def _prefill_embed(cfg: MoeLMConfig, params, ids):
+    """Prefill chunk 0: embedding + positional add over the padded
+    prompt buffer ``ids`` [1, Ts]; allocates the K/V capture stacks."""
+    dt = cfg.compute_dtype
+    Ts = ids.shape[1]
+    x = (emb_ops.embedding_lookup(params["emb"], ids).astype(dt)
+         + params["pos"][:Ts].astype(dt)[None])
+    z = jnp.zeros((cfg.num_layers, 1, Ts, cfg.model_dim), dt)
+    return {"x": x, "pk": z, "pv": z, "ids": ids}
+
+
+def _prefill_layers(cfg: MoeLMConfig, params, carry, lo, hi):
+    """Prefill layers ``[lo, hi)``: capture each layer's prompt K/V
+    projections (of the RAW block input), then apply the post-LN MoE
+    block. Padded rows route through the MoE too (garbage, dropped by
+    the serve insert's sentinel mask)."""
+    dt = cfg.compute_dtype
+    x, pk, pv = carry["x"], carry["pk"], carry["pv"]
+    B, Ts, D = x.shape
+    Hn = cfg.num_heads
+    mesh = emb_ops.current_mesh()
+
+    def heads(z):
+        return z.reshape(B, Ts, Hn, D // Hn)
+
+    for i in range(lo, hi):
+        p = params["blocks"][i]
+        q, k, v = jnp.split(x @ p["wqkv"].astype(dt), 3, -1)
+        pk = pk.at[i].set(k)
+        pv = pv.at[i].set(v)
+        out = full_attention_reference(heads(q), heads(k), heads(v),
+                                       causal=True)
+        x = _serve_layer_norm(x + out.reshape(B, Ts, D) @ p["wo"].astype(dt),
+                         p["ln1"])
+        moe_out, _, _ = moe_ops.switch_moe(
+            x.reshape(B * Ts, D), p["router"], p["moe_w1"], p["moe_w2"],
+            mesh, cfg.capacity_factor, top_k=cfg.top_k)
+        x = _serve_layer_norm(x + moe_out.reshape(B, Ts, D).astype(dt),
+                         p["ln2"])
+    return {"x": x, "pk": pk, "pv": pv, "ids": carry["ids"]}
+
+
+def _decode_step_cached(cfg: MoeLMConfig, params, tok, t, base, first,
+                        kc, vc, pages=None, page_size=None,
+                        attn_impl=None):
+    """One batched cached decoder step (see long_context's docstring for
+    the row contract): post-LN blocks, switch-MoE MLP routed per token
+    at S tokens, padded-vocab logits masked before the argmax."""
+    dt = cfg.compute_dtype
+    D = cfg.model_dim
+    S = tok.shape[0]
+    mesh = emb_ops.current_mesh()
+    paged = pages is not None
+    if paged:
+        from parallax_tpu.ops import pallas_paged_attention as _ppa
+        pool, ps = kc.shape[1], int(page_size)
+        Tbuf = pages.shape[1] * ps
+        impl = _ppa.resolve_impl(
+            attn_impl, G=1, D=D, page_size=ps,
+            num_heads=cfg.num_heads,
+            itemsize=jnp.dtype(dt).itemsize)
+    else:
+        Tbuf = kc.shape[2]
+        rows = jnp.arange(S)
+    tok_eff = jnp.where(t == 0, first, tok)
+    pos = (base + t)[:, None]                                # [S, 1]
+    pos_emb = jnp.take(params["pos"].astype(dt), pos, axis=0,
+                       mode="clip")                          # [S, 1, D]
+    x = (emb_ops.embedding_lookup(params["emb"],
+                                  tok_eff[:, None]).astype(dt)
+         + pos_emb)                                          # [S, 1, D]
+    mask = (jnp.arange(Tbuf)[None, :] <= pos)[:, None, None, :]
+    if paged:
+        pg, off = _ppa.sentinel_write_coords(pages, pos, ps, pool)
+    for i, p in enumerate(params["blocks"]):
+        q, k_t, v_t = jnp.split(x @ p["wqkv"].astype(dt), 3, -1)
+        if paged:
+            kc = kc.at[i, pg, off].set(k_t, mode="drop")
+            vc = vc.at[i, pg, off].set(v_t, mode="drop")
+            if impl == "kernel":
+                y = _ppa.paged_decode_attention(
+                    q, kc[i], vc[i], pages, pos,
+                    num_heads=cfg.num_heads, page_size=ps,
+                    impl="kernel")
+            else:
+                k_all = _ppa.paged_gather(kc[i], pages)
+                v_all = _ppa.paged_gather(vc[i], pages)
+                y = _serve_attention(q, k_all, v_all, mask,
+                                     cfg.num_heads)
+        else:
+            kc = kc.at[i, rows[:, None], pos].set(k_t, mode="drop")
+            vc = vc.at[i, rows[:, None], pos].set(v_t, mode="drop")
+            y = _serve_attention(q, kc[i], vc[i], mask, cfg.num_heads)
+        x = _serve_layer_norm(x + y @ p["wo"].astype(dt), p["ln1"])
+        moe_out, _, _ = moe_ops.switch_moe(
+            x.reshape(S, D), p["router"], p["moe_w1"], p["moe_w2"],
+            mesh, cfg.capacity_factor, top_k=cfg.top_k)
+        x = _serve_layer_norm(x + moe_out.reshape(S, 1, D).astype(dt),
+                         p["ln2"])
+    logits = x[:, 0].astype(jnp.float32) @ params["out_w"]
+    return emb_ops.mask_padded_logits(logits, cfg.vocab_size), kc, vc
+
+
+def _init_serve_self_cache(cfg: MoeLMConfig, batch: int, max_len: int):
+    z = jnp.zeros((cfg.num_layers, batch, max_len, cfg.model_dim),
+                  cfg.compute_dtype)
+    return z, z
+
+
+def _init_serve_paged_cache(cfg: MoeLMConfig, pool_pages: int,
+                            page_size: int):
+    z = jnp.zeros((cfg.num_layers, pool_pages, page_size,
+                   cfg.model_dim), cfg.compute_dtype)
+    return z, z

@@ -25,9 +25,12 @@ Two classes:
   Phases: ``admission`` (submit-side validation/padding),
   ``queue_wait`` (enqueue -> popped by a serving loop), ``prefill``
   (pop -> slot activation; per-chunk durations in
-  ``prefill_chunks_ms``), ``prefix_replay`` (the prefix-cache hit's
-  substitute for prefill: cached tokens/pages mapped instead of
-  computed — ISSUE 15), ``slot_wait`` (page-pool-exhausted refill
+  ``prefill_chunks_ms``), ``kv_transfer`` (disaggregated serving's
+  inter-pool hop: prefill state exported, moved as wire bytes and
+  imported into the decode replica — ISSUE 19), ``prefix_replay``
+  (the prefix-cache hit's substitute for prefill: cached tokens/pages
+  mapped instead of computed — ISSUE 15), ``slot_wait`` (page-pool-
+  exhausted refill
   deferrals), ``decode`` (activation -> retire), ``service`` (the
   one-shot batcher's dispatch+infer+split), ``failover`` (replica
   death -> re-placement). Alongside: the replica hop trail, retries
@@ -79,8 +82,14 @@ from parallax_tpu.obs.metrics import (MetricsRegistry, nearest_rank,
 # and its EXPLICIT presence in the TTFT decomposition (next to the
 # record's ``prefill_tokens_skipped`` count) is what attributes the
 # skipped prefill rather than leaving a hole in the timeline.
-PHASES = ("admission", "queue_wait", "prefill", "prefix_replay",
-          "slot_wait", "decode", "service", "failover")
+# ``kv_transfer`` (ISSUE 19) is the disaggregated hop between pools:
+# prefill finished on a prefill replica -> request state exported,
+# moved as wire bytes and imported into the decode replica's prefix
+# cache. It sits between ``prefill`` and the decode pool's
+# ``queue_wait``, so a disaggregated request's phases still partition
+# its wall clock and sum(ttft_decomp) == client TTFT holds unchanged.
+PHASES = ("admission", "queue_wait", "prefill", "kv_transfer",
+          "prefix_replay", "slot_wait", "decode", "service", "failover")
 
 DEFAULT_CAPACITY = 512
 

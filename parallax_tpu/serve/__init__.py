@@ -17,7 +17,17 @@ request path of the ROADMAP north star ("serving heavy traffic"):
     and free slots refill mid-flight instead of waiting for the
     batch's slowest member (Orca-style continuous batching).
   * :mod:`~parallax_tpu.serve.adapters` — DecodeProgram bindings for
-    the repo's models (NMT greedy decode).
+    the repo's models (ISSUE 19): the NMT encoder-decoder, the causal
+    decoder LM (long-context shapes, riding the fused paged-attention
+    kernel), the MoE-LM (expert-sharded decode) and the lm1b LSTM,
+    plus the adapter registry the conformance suite and SLO guard
+    iterate (``register_adapter`` / ``registered_adapters``) and
+    ``standalone_greedy`` — the bit-identity reference decoder.
+  * :mod:`~parallax_tpu.serve.disagg` — disaggregated prefill/decode
+    serving (ISSUE 19): a prefill pool and a decode pool behind one
+    front door, with a host-side wire protocol streaming finished
+    prefill state into the decode pool's prefix caches and
+    independent per-pool autoscaling.
   * :mod:`~parallax_tpu.serve.prefixcache` — prefix-aware KV reuse
     (ISSUE 15): a per-tenant radix index over finished sequences'
     token prefixes backed by ref-counted pool pages; identical
@@ -50,8 +60,15 @@ and zero recompiles) in tier-1.
 """
 
 from parallax_tpu.common.config import ServeConfig
-from parallax_tpu.serve.adapters import (NMTDecodeProgram,
-                                         layer_skip_draft)
+from parallax_tpu.serve.adapters import (AdapterSpec,
+                                         CausalLMDecodeProgram,
+                                         LM1BDecodeProgram,
+                                         MoeLMDecodeProgram,
+                                         NMTDecodeProgram,
+                                         layer_skip_draft,
+                                         register_adapter,
+                                         registered_adapters,
+                                         standalone_greedy)
 from parallax_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
                                         ReplicaUnavailable, Request,
                                         RequestQueue, ServeClosed,
@@ -59,6 +76,8 @@ from parallax_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
                                         TenantQuotaExceeded)
 from parallax_tpu.serve.continuous import (ContinuousScheduler,
                                            DecodeProgram)
+from parallax_tpu.serve.disagg import (DisaggFleet, export_prefill,
+                                       import_prefill)
 from parallax_tpu.serve.faults import (FaultInjector, InjectedFault,
                                        ReplicaCrash)
 from parallax_tpu.serve.fleet import (FleetConfig, FleetRequest,
@@ -73,10 +92,13 @@ from parallax_tpu.serve.session import ServeSession
 __all__ = [
     "ServeSession", "ServeConfig", "Request", "RequestQueue",
     "MicroBatcher", "ContinuousScheduler", "DecodeProgram",
-    "NMTDecodeProgram", "layer_skip_draft", "PageAllocator",
-    "PagePoolExhausted", "pages_for", "ServeError", "ServeOverloaded",
-    "DeadlineExceeded", "ServeClosed", "ReplicaUnavailable",
-    "ServeFleet", "FleetConfig", "FleetRequest", "Router",
+    "NMTDecodeProgram", "CausalLMDecodeProgram", "MoeLMDecodeProgram",
+    "LM1BDecodeProgram", "AdapterSpec", "register_adapter",
+    "registered_adapters", "standalone_greedy", "layer_skip_draft",
+    "PageAllocator", "PagePoolExhausted", "pages_for", "ServeError",
+    "ServeOverloaded", "DeadlineExceeded", "ServeClosed",
+    "ReplicaUnavailable", "ServeFleet", "FleetConfig", "FleetRequest",
+    "DisaggFleet", "export_prefill", "import_prefill", "Router",
     "ReplicaHandle", "HealthPolicy", "FaultInjector", "InjectedFault",
     "ReplicaCrash", "TenantQuotaExceeded", "RadixPrefixCache",
     "CacheEntry",

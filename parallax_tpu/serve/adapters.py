@@ -472,4 +472,572 @@ def layer_skip_draft(cfg: nmt.NMTConfig, params, layers: int = 1):
     return draft_cfg, draft_params
 
 
-__all__ = ["NMTDecodeProgram", "layer_skip_draft"]
+# ----- decoder-only causal-LM adapters (ISSUE 19) -------------------------
+# One skeleton serves every decoder-only transformer in the repo: the
+# model module supplies the serve decode section (_prefill_embed /
+# _prefill_layers / _prefill_finish / _decode_step_cached /
+# _init_serve_*_cache — models/long_context.py, models/moe_lm.py) and
+# the skeleton supplies the contract plumbing. Decoder-only prompts
+# differ from NMT in one structural way: the prompt's K/V lives in the
+# SAME cache the decode steps write (there is no separate cross-KV), so
+# ``insert`` must scatter the prompt rows through the slot's page table
+# — the ``insert_pages`` capability the scheduler probes. Padded prompt
+# rows route to the OOB sentinel and DROP: a prefix-cache hit hands a
+# slot SHARED pages, and a blind dense write of the padded tail would
+# corrupt the replayed-token K/V other holders still read.
+
+
+class _CausalKVDecodeProgram(DecodeProgram):
+    """Shared greedy KV-cached decode for decoder-only causal LMs.
+
+    ``max_src_len`` (= Ts) fixes the padded prompt buffer; ``max_len``
+    is the per-request NEW-token cap. The cache buffer holds
+    ``Tbuf = Ts + max_len`` positions — prompt K/V at [0, t0) written
+    by :meth:`insert`, decode step ``t`` writing position
+    ``base + t`` where ``base = t0 - 1`` (step 0 consumes the LAST
+    prompt token and emits the first new one). Requires
+    ``Ts + max_len <= cfg.max_len`` (positional-table coverage).
+
+    Paged layout (``page_size``): identical pool/sentinel semantics to
+    :class:`NMTDecodeProgram`, with ``page_size`` dividing ``Tbuf`` so
+    the gathered buffer matches the dense width (bit-identity), plus
+    page-table-routed prompt insertion (``insert_pages``). The PR 16
+    fused paged-attention kernel serves the step unchanged via
+    ``attn_impl``.
+
+    Token-id conventions: 0 is PAD/BOS/EOS at once — prompts must use
+    ids in [1, vocab); a generated 0 retires the request.
+    """
+
+    _mod = None          # model module with the serve decode section
+
+    def __init__(self, cfg, max_src_len: int, max_len: int, *,
+                 page_size: Optional[int] = None,
+                 pool_pages: Optional[int] = None,
+                 prefill_chunk_layers: Optional[int] = None,
+                 attn_impl: Optional[str] = None):
+        self.cfg = cfg
+        self.Ts = int(max_src_len)
+        self.max_len = int(max_len)
+        if self.Ts < 1 or self.max_len < 1:
+            raise ValueError(
+                f"max_src_len={max_src_len} / max_len={max_len} must "
+                f"be >= 1")
+        self.Tbuf = self.Ts + self.max_len
+        if self.Tbuf > cfg.max_len:
+            raise ValueError(
+                f"max_src_len + max_len = {self.Tbuf} exceeds the "
+                f"model's positional table ({cfg.max_len}): every "
+                f"decode position base + t must have an embedding row")
+        self.bos_id = 0
+        self.eos_id = 0
+        self.pad_id = 0
+
+        self.paged = page_size is not None
+        if self.paged:
+            if pool_pages is None:
+                raise ValueError(
+                    "page_size given without pool_pages; the pool size "
+                    "is the memory bound and must be declared")
+            self.page_size = int(page_size)
+            self.pool_pages = int(pool_pages)
+            if self.page_size < 1 or self.pool_pages < 1:
+                raise ValueError(
+                    f"page_size={page_size} / pool_pages={pool_pages} "
+                    f"must be >= 1")
+            if self.Tbuf % self.page_size != 0:
+                raise ValueError(
+                    f"page_size={page_size} must divide max_src_len + "
+                    f"max_len = {self.Tbuf}: the gathered attention "
+                    f"buffer must match the dense buffer width exactly "
+                    f"(bit-identity contract)")
+            self.pages_per_seq = self.Tbuf // self.page_size
+            if self.pool_pages < self.pages_per_seq:
+                raise ValueError(
+                    f"pool_pages={pool_pages} cannot hold even one "
+                    f"max-length sequence ({self.pages_per_seq} pages)")
+        elif pool_pages is not None:
+            raise ValueError("pool_pages given without page_size")
+        # prompt K/V scatters through the slot's page table (see the
+        # section comment) — the scheduler passes insert the page row
+        self.insert_pages = self.paged
+
+        if attn_impl is not None and attn_impl not in (
+                "auto", "kernel", "einsum"):
+            raise ValueError(
+                f"attn_impl={attn_impl!r}: expected 'auto', 'kernel' "
+                f"or 'einsum'")
+        if attn_impl == "kernel" and not self.paged:
+            raise ValueError(
+                "attn_impl='kernel' requires the paged KV layout "
+                "(page_size/pool_pages): the kernel's operand is the "
+                "page-table-addressed pool")
+        self.attn_impl = attn_impl
+
+        L = cfg.num_layers
+        if prefill_chunk_layers is not None:
+            c = int(prefill_chunk_layers)
+            if not 1 <= c <= L:
+                raise ValueError(
+                    f"prefill_chunk_layers={prefill_chunk_layers} "
+                    f"outside [1, num_layers={L}]")
+            self._layer_chunks = [(k * c, min((k + 1) * c, L))
+                                  for k in range(-(-L // c))]
+            self.num_prefill_chunks = len(self._layer_chunks) + 1
+        else:
+            self._layer_chunks = None
+            self.num_prefill_chunks = 1
+
+        self._prefill_jit = jax.jit(self._prefill)
+        self._insert_jit = jax.jit(
+            self._insert_paged if self.paged else self._insert_dense)
+        self._step_jit = jax.jit(self._step)
+        if self.paged:
+            self._copy_page_jit = jax.jit(self._copy_page)
+        if self._layer_chunks is not None:
+            self._chunk_jits = [
+                jax.jit(functools.partial(self._prefill_embed_chunk,
+                                          hi=self._layer_chunks[0][1]))]
+            for lo, hi in self._layer_chunks[1:]:
+                self._chunk_jits.append(jax.jit(functools.partial(
+                    self._prefill_layers_chunk, lo=lo, hi=hi)))
+            self._chunk_jits.append(jax.jit(self._prefill_finish_chunk))
+
+    # -- feed contract -----------------------------------------------------
+
+    def example_feed(self) -> Dict[str, np.ndarray]:
+        return {"ids": np.ones((1,), np.int32)}
+
+    def prepare_feed(self, feed: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        ids = np.asarray(feed["ids"], np.int32)
+        if ids.ndim != 1:
+            raise ValueError(
+                f"decode feed 'ids' must be one request's [T] prompt "
+                f"row, got shape {ids.shape}")
+        if not 1 <= ids.shape[0] <= self.Ts:
+            raise ValueError(
+                f"prompt length {ids.shape[0]} outside [1, "
+                f"max_src_len={self.Ts}]")
+        if (ids < 1).any() or (ids >= self.cfg.vocab_size).any():
+            raise ValueError(
+                "prompt ids must lie in [1, vocab_size): 0 is the "
+                "PAD/BOS/EOS sentinel")
+        return {"ids": bucketing.pad_axis0(ids, self.Ts, self.pad_id)}
+
+    def pages_needed(self, cap: int) -> int:
+        """Worst-case pages for a request with NEW-token cap ``cap``:
+        the longest prompt occupies ``Ts - 1`` positions before step 0
+        and step ``cap - 1`` writes position ``Ts - 2 + cap``."""
+        return pages_for(self.Ts - 1 + int(cap), self.page_size)
+
+    def kv_prefix_positions(self, feed) -> int:
+        """Cache positions a PREPARED feed's prompt occupies before the
+        first decode step writes (= base = t0 - 1; step 0 rewrites the
+        last prompt position) — the scheduler's page/prefix-share
+        accounting hook for adapters whose prompt K/V shares the decode
+        cache."""
+        t0 = int((np.asarray(feed["ids"]) != self.pad_id).sum())
+        return max(t0 - 1, 0)
+
+    # -- prefix-reuse hooks ------------------------------------------------
+
+    def prefix_key(self, feed) -> tuple:
+        """Exact-key semantics like the NMT adapter: the padded prompt
+        row as a token tuple. (A causal prompt's K/V WOULD be prefix-
+        sharable position-wise, but the radix cache's replay machinery
+        keys whole prompts and replays generated continuations — the
+        same contract every adapter satisfies.)"""
+        return tuple(int(t) for t in feed["ids"])
+
+    def prefill_tokens(self, feed) -> int:
+        return int((np.asarray(feed["ids"]) != self.pad_id).sum())
+
+    def copy_page(self, state, dst, src):
+        """Device-side COW page copy — see NMTDecodeProgram.copy_page."""
+        return self._copy_page_jit(state, jnp.asarray(dst, jnp.int32),
+                                   jnp.asarray(src, jnp.int32))
+
+    def _copy_page(self, state, dst, src):
+        out = dict(state)
+        for name in ("kc", "vc"):
+            pool = state[name]                 # [L, pool, ps, D]
+            page = jax.lax.dynamic_slice_in_dim(pool, src, 1, axis=1)
+            out[name] = jax.lax.dynamic_update_slice_in_dim(
+                pool, page, dst, axis=1)
+        return out
+
+    # -- device programs ---------------------------------------------------
+
+    def init_state(self, params, slots: int) -> Dict[str, jax.Array]:
+        if self.paged:
+            kc, vc = self._mod._init_serve_paged_cache(
+                self.cfg, self.pool_pages, self.page_size)
+        else:
+            kc, vc = self._mod._init_serve_self_cache(
+                self.cfg, slots, self.Tbuf)
+        return {"kc": kc, "vc": vc,
+                "base": jnp.zeros((slots,), jnp.int32),
+                "first": jnp.zeros((slots,), jnp.int32)}
+
+    def prefill(self, params, feed):
+        return self._prefill_jit(params, feed)
+
+    def _prefill(self, params, feed):
+        carry = self._mod._prefill_embed(self.cfg, params,
+                                         feed["ids"][None])
+        carry = self._mod._prefill_layers(self.cfg, params, carry, 0,
+                                          self.cfg.num_layers)
+        return self._mod._prefill_finish(carry, self.pad_id)
+
+    def prefill_chunk(self, params, carry, k: int):
+        return self._chunk_jits[k](params, carry)
+
+    def _prefill_embed_chunk(self, params, feed, hi: int):
+        carry = self._mod._prefill_embed(self.cfg, params,
+                                         feed["ids"][None])
+        return self._mod._prefill_layers(self.cfg, params, carry, 0, hi)
+
+    def _prefill_layers_chunk(self, params, carry, lo: int, hi: int):
+        return self._mod._prefill_layers(self.cfg, params, carry, lo, hi)
+
+    def _prefill_finish_chunk(self, params, carry):
+        return self._mod._prefill_finish(carry, self.pad_id)
+
+    def insert(self, state, slot, request_state, pages=None):
+        if self.insert_pages:
+            return self._insert_jit(state, slot, request_state,
+                                    jnp.asarray(pages, jnp.int32))
+        return self._insert_jit(state, slot, request_state)
+
+    def _insert_scalars(self, out, state, slot, rs):
+        out["base"] = jax.lax.dynamic_update_slice(
+            state["base"], rs["base"], (slot,))
+        out["first"] = jax.lax.dynamic_update_slice(
+            state["first"], rs["first"], (slot,))
+        return out
+
+    def _insert_dense(self, state, slot, rs):
+        # the padded tail writes garbage into the slot's OWN rows at
+        # positions >= t0 — harmless: step t rewrites position base+t
+        # before any query's mask reaches it
+        out = dict(state)
+        out["kc"] = jax.lax.dynamic_update_slice(
+            state["kc"], rs["pk"], (0, slot, 0, 0))
+        out["vc"] = jax.lax.dynamic_update_slice(
+            state["vc"], rs["pv"], (0, slot, 0, 0))
+        return self._insert_scalars(out, state, slot, rs)
+
+    def _insert_paged(self, state, slot, rs, pages_row):
+        # prompt positions j < t0 land in page pages_row[j // ps]; the
+        # padded tail maps to position Tbuf -> beyond the table -> OOB
+        # DROP. This mask is correctness-critical: on a prefix hit the
+        # row names SHARED pages holding replayed-token K/V that other
+        # holders read.
+        from parallax_tpu.ops import pallas_paged_attention as _ppa
+        out = dict(state)
+        t0 = rs["base"][0] + 1
+        j = jnp.arange(self.Ts)
+        pos = jnp.where(j < t0, j, self.Tbuf)[None]          # [1, Ts]
+        pg, off = _ppa.sentinel_write_coords(
+            pages_row[None], pos, self.page_size, self.pool_pages)
+        out["kc"] = state["kc"].at[:, pg[0], off[0]].set(
+            rs["pk"][:, 0], mode="drop")
+        out["vc"] = state["vc"].at[:, pg[0], off[0]].set(
+            rs["pv"][:, 0], mode="drop")
+        return self._insert_scalars(out, state, slot, rs)
+
+    def step(self, params, state, tok, t, pages=None):
+        return self._step_jit(params, state, tok, t, pages)
+
+    def _step(self, params, state, tok, t, pages):
+        if self.paged:
+            logits, kc, vc = self._mod._decode_step_cached(
+                self.cfg, params, tok, t, state["base"], state["first"],
+                state["kc"], state["vc"], pages=pages,
+                page_size=self.page_size, attn_impl=self.attn_impl)
+        else:
+            logits, kc, vc = self._mod._decode_step_cached(
+                self.cfg, params, tok, t, state["base"], state["first"],
+                state["kc"], state["vc"])
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out = dict(state)
+        out["kc"], out["vc"] = kc, vc
+        return nxt, out
+
+
+class CausalLMDecodeProgram(_CausalKVDecodeProgram):
+    """Greedy KV-cached decode for models/long_context.py (data-path
+    block math, pre-LN). Rides the PR 16 fused paged-attention kernel
+    unchanged via ``attn_impl='kernel'``. Serving uses the per-layer
+    ``blocks`` param layout — pipeline-stacked params cannot serve."""
+
+    def __init__(self, cfg, max_src_len: int, max_len: int, **kw):
+        from parallax_tpu.models import long_context
+        if cfg.parallelism == "pipeline":
+            raise ValueError(
+                "serving needs the per-layer 'blocks' param layout; "
+                "parallelism='pipeline' stores blocks_stacked")
+        self._mod = long_context
+        super().__init__(cfg, max_src_len, max_len, **kw)
+
+
+class MoeLMDecodeProgram(_CausalKVDecodeProgram):
+    """Greedy KV-cached decode for models/moe_lm.py (post-LN switch-MoE
+    blocks) — the serving face of the sparsity thesis: each decode step
+    routes S tokens through ops/moe.switch_moe, so expert weights shard
+    over the mesh exactly as in training. Without a mesh the dense
+    per-token expert path runs (row-wise, no capacity drops — the
+    exact-under-greedy configuration); under a live mesh the
+    capacity-bounded all_to_all dispatch applies and co-batched slots
+    can contend for expert capacity (documented caveat)."""
+
+    def __init__(self, cfg, max_src_len: int, max_len: int, **kw):
+        from parallax_tpu.models import moe_lm
+        self._mod = moe_lm
+        super().__init__(cfg, max_src_len, max_len, **kw)
+
+
+class LM1BDecodeProgram(DecodeProgram):
+    """Greedy decode for models/lm1b.py — the adapter that proves the
+    DecodeProgram contract is not transformer-shaped: the "cache" is
+    the LSTM carry itself ([S, H] cell + [S, P] hidden per slot), there
+    are no pages and no positions, and ``t`` matters only for the
+    step-0 first-token gate. Dense-only (``paged`` absent); requests
+    run to their cap (``eos_id = -1`` never fires). Greedy uses the
+    full softmax projection — sampled softmax is a training loss."""
+
+    def __init__(self, cfg, max_src_len: int, max_len: int):
+        self.cfg = cfg
+        self.Ts = int(max_src_len)
+        self.max_len = int(max_len)
+        if self.Ts < 1 or self.max_len < 1:
+            raise ValueError(
+                f"max_src_len={max_src_len} / max_len={max_len} must "
+                f"be >= 1")
+        self.bos_id = 0
+        self.pad_id = 0
+        self.eos_id = -1
+        self._prefill_jit = jax.jit(self._prefill)
+        self._insert_jit = jax.jit(self._insert)
+        self._step_jit = jax.jit(self._step)
+
+    def example_feed(self) -> Dict[str, np.ndarray]:
+        return {"ids": np.ones((1,), np.int32)}
+
+    def prepare_feed(self, feed: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        ids = np.asarray(feed["ids"], np.int32)
+        if ids.ndim != 1:
+            raise ValueError(
+                f"decode feed 'ids' must be one request's [T] prompt "
+                f"row, got shape {ids.shape}")
+        if not 1 <= ids.shape[0] <= self.Ts:
+            raise ValueError(
+                f"prompt length {ids.shape[0]} outside [1, "
+                f"max_src_len={self.Ts}]")
+        if (ids < 1).any() or (ids >= self.cfg.vocab_size).any():
+            raise ValueError(
+                "prompt ids must lie in [1, vocab_size): 0 is the "
+                "PAD sentinel")
+        return {"ids": bucketing.pad_axis0(ids, self.Ts, self.pad_id)}
+
+    def init_state(self, params, slots: int) -> Dict[str, jax.Array]:
+        cdt = self.cfg.compute_dtype
+        return {"c": jnp.zeros((slots, self.cfg.hidden_dim), cdt),
+                "h": jnp.zeros((slots, self.cfg.proj_dim), cdt),
+                "first": jnp.zeros((slots,), jnp.int32)}
+
+    def prefill(self, params, feed):
+        return self._prefill_jit(params, feed)
+
+    def _prefill(self, params, feed):
+        from parallax_tpu.models import lm1b
+        c, h, _, first = lm1b._lstm_prefill(
+            self.cfg, params, feed["ids"][None], self.pad_id)
+        return {"c": c, "h": h, "first": first}
+
+    def insert(self, state, slot, request_state):
+        return self._insert_jit(state, slot, request_state)
+
+    def _insert(self, state, slot, rs):
+        return {
+            "c": jax.lax.dynamic_update_slice(state["c"], rs["c"],
+                                              (slot, 0)),
+            "h": jax.lax.dynamic_update_slice(state["h"], rs["h"],
+                                              (slot, 0)),
+            "first": jax.lax.dynamic_update_slice(
+                state["first"], rs["first"], (slot,)),
+        }
+
+    def step(self, params, state, tok, t, pages=None):
+        return self._step_jit(params, state, tok, t)
+
+    def _step(self, params, state, tok, t):
+        from parallax_tpu.models import lm1b
+        tok_eff = jnp.where(t == 0, state["first"], tok)
+        logits, c, h = lm1b._lstm_decode_step(self.cfg, params, tok_eff,
+                                              state["c"], state["h"])
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return nxt, {"c": c, "h": h, "first": state["first"]}
+
+
+# ----- standalone greedy reference ----------------------------------------
+
+
+def standalone_greedy(program: DecodeProgram, params, feed,
+                      max_new_tokens: int):
+    """Reference greedy decode through the program's OWN device math,
+    outside any session/scheduler: fresh single-slot state, prefill (or
+    every chunk), insert, then a sequential step loop. The conformance
+    rig (tests/test_adapters.py) pins served tokens bit-identical to
+    this — the exact-under-greedy guarantee each adapter makes.
+
+    Single-shot jit signatures here are S=1-shaped (a different trace
+    than a serve session's S-slot batch), so run it OUTSIDE recompile
+    guards. Returns the emitted token list (eos included when hit)."""
+    prepared = program.prepare_feed(feed)
+    if getattr(program, "num_prefill_chunks", 1) > 1:
+        carry = prepared
+        for k in range(program.num_prefill_chunks):
+            carry = program.prefill_chunk(params, carry, k)
+        rs = carry
+    else:
+        rs = program.prefill(params, prepared)
+    state = program.init_state(params, 1)
+    cap = int(max_new_tokens)
+    paged = bool(getattr(program, "paged", False))
+    pages = None
+    if paged:
+        row = np.full((program.pages_per_seq,), program.pool_pages,
+                      np.int32)
+        need = min(program.pages_needed(cap), program.pages_per_seq)
+        row[:need] = np.arange(need, dtype=np.int32)
+        pages = jnp.asarray(row[None])
+    if getattr(program, "insert_pages", False):
+        state = program.insert(state, np.int32(0), rs, row)
+    else:
+        state = program.insert(state, np.int32(0), rs)
+    toks = []
+    tok = np.full((1,), program.bos_id, np.int32)
+    t = np.zeros((1,), np.int32)
+    for _ in range(cap):
+        if paged:
+            nxt, state = program.step(params, state, jnp.asarray(tok),
+                                      jnp.asarray(t), pages)
+        else:
+            nxt, state = program.step(params, state, jnp.asarray(tok),
+                                      jnp.asarray(t))
+        nt = int(np.asarray(nxt)[0])
+        toks.append(nt)
+        if nt == program.eos_id:
+            break
+        tok = np.array([nt], np.int32)
+        t = t + 1
+    return toks
+
+
+# ----- adapter registry ---------------------------------------------------
+# One spec per served model family. The conformance rig
+# (tests/test_adapters.py) parametrizes over this table, so a fourth
+# adapter is a subclass plus a register_adapter call — not a new test
+# file. Fixtures build tiny float32 configs (bit-identity across
+# executors needs fp32 accumulation everywhere, the demo_decode_fleet
+# precedent).
+
+
+@dataclasses.dataclass(frozen=True)
+class AdapterSpec:
+    """Registry row: how to build a tiny serving fixture of one adapter.
+
+    ``build(paged, chunked)`` returns ``(program, params)``;
+    ``make_feed(rng)`` returns one raw request feed; ``paged``/
+    ``chunked`` say which layouts the adapter supports (the rig skips
+    unsupported combinations)."""
+    name: str
+    build: Any
+    make_feed: Any
+    paged: bool = True
+    chunked: bool = True
+
+
+_ADAPTERS: Dict[str, AdapterSpec] = {}
+
+
+def register_adapter(spec: AdapterSpec) -> AdapterSpec:
+    _ADAPTERS[spec.name] = spec
+    return spec
+
+
+def registered_adapters() -> Dict[str, AdapterSpec]:
+    return dict(_ADAPTERS)
+
+
+def _nmt_fixture(paged: bool = True, chunked: bool = False):
+    cfg = nmt.tiny_config(compute_dtype=jnp.float32)
+    params = nmt.build_model(cfg).init_fn(jax.random.PRNGKey(0))
+    prog = NMTDecodeProgram(
+        cfg, max_src_len=8, max_len=8,
+        page_size=4 if paged else None,
+        pool_pages=96 if paged else None,
+        prefill_chunk_layers=1 if chunked else None)
+    return prog, params
+
+
+def _nmt_feed(rng: np.random.Generator):
+    n = int(rng.integers(2, 8))
+    return {"src": rng.integers(3, 512, (n,)).astype(np.int32)}
+
+
+def _causal_lm_fixture(paged: bool = True, chunked: bool = False):
+    from parallax_tpu.models import long_context
+    cfg = long_context.tiny_config(parallelism="data",
+                                   compute_dtype=jnp.float32)
+    params = long_context.build_model(cfg).init_fn(jax.random.PRNGKey(1))
+    prog = CausalLMDecodeProgram(
+        cfg, max_src_len=8, max_len=8,
+        page_size=4 if paged else None,
+        pool_pages=96 if paged else None,
+        prefill_chunk_layers=1 if chunked else None)
+    return prog, params
+
+
+def _moe_lm_fixture(paged: bool = True, chunked: bool = False):
+    from parallax_tpu.models import moe_lm
+    cfg = moe_lm.tiny_config(compute_dtype=jnp.float32)
+    params = moe_lm.build_model(cfg).init_fn(jax.random.PRNGKey(2))
+    prog = MoeLMDecodeProgram(
+        cfg, max_src_len=8, max_len=8,
+        page_size=4 if paged else None,
+        pool_pages=96 if paged else None,
+        prefill_chunk_layers=1 if chunked else None)
+    return prog, params
+
+
+def _lm_feed(rng: np.random.Generator):
+    n = int(rng.integers(2, 8))
+    return {"ids": rng.integers(1, 512, (n,)).astype(np.int32)}
+
+
+def _lm1b_fixture(paged: bool = False, chunked: bool = False):
+    from parallax_tpu.models import lm1b
+    cfg = lm1b.tiny_config(compute_dtype=jnp.float32)
+    params = lm1b.build_model(cfg).init_fn(jax.random.PRNGKey(3))
+    prog = LM1BDecodeProgram(cfg, max_src_len=8, max_len=8)
+    return prog, params
+
+
+def _lm1b_feed(rng: np.random.Generator):
+    n = int(rng.integers(2, 8))
+    return {"ids": rng.integers(1, 1000, (n,)).astype(np.int32)}
+
+
+register_adapter(AdapterSpec("nmt", _nmt_fixture, _nmt_feed))
+register_adapter(AdapterSpec("causal_lm", _causal_lm_fixture, _lm_feed))
+register_adapter(AdapterSpec("moe_lm", _moe_lm_fixture, _lm_feed))
+register_adapter(AdapterSpec("lm1b", _lm1b_fixture, _lm1b_feed,
+                             paged=False, chunked=False))
+
+
+__all__ = ["NMTDecodeProgram", "CausalLMDecodeProgram",
+           "MoeLMDecodeProgram", "LM1BDecodeProgram", "AdapterSpec",
+           "register_adapter", "registered_adapters",
+           "standalone_greedy", "layer_skip_draft"]

@@ -87,6 +87,18 @@ class DecodeProgram:
       (y [S, k+1], proposals [S, k], state)`` instead of ``step`` and
       accepts the longest agreeing prefix (``prev_tok`` is the content
       at position t-1 — the draft's catch-up input).
+    * ``insert_pages`` (False): decoder-only programs whose PROMPT KV
+      lands in the slot's own paged decode buffer take the slot's page
+      row too: ``insert(state, slot, request_state, pages_row)`` with
+      ``pages_row`` the ``[pages_per_seq]`` int32 row (sentinel-filled
+      past the allocation). The insert must route padded prompt rows
+      through the sentinel (OOB -> dropped) so a prefix-mapped slot
+      never writes garbage into shared pages.
+    * ``kv_prefix_positions(feed) -> int`` (optional): how many decode
+      buffer positions the PROMPT occupies before the first decoded
+      token (0 for encoder-decoder programs, whose self-KV starts
+      empty). The scheduler uses it to convert token counts into page
+      offsets for prefix sharing and retire-time caching.
 
     Core callables (shapes fixed per instance):
 
@@ -112,7 +124,7 @@ class DecodeProgram:
 
 class _Slot:
     __slots__ = ("req", "tokens", "t", "cap", "pages", "rs", "key",
-                 "entry", "replayed")
+                 "entry", "replayed", "base")
 
     def __init__(self, req: Request, cap: int, pages: List[int]):
         self.req = req
@@ -120,6 +132,10 @@ class _Slot:
         self.t = 0
         self.cap = cap
         self.pages = pages
+        # decode-buffer positions the PROMPT occupies ahead of the
+        # decoded tokens (kv_prefix_positions; 0 for encoder-decoder
+        # programs) — page-occupancy math is in POSITIONS, not tokens
+        self.base = 0
         # prefix-reuse bookkeeping (ISSUE 15): the prefill request
         # state (kept so a retiring sequence can be cached), the radix
         # key, the mapped cache entry (pinned while we run), and how
@@ -192,6 +208,9 @@ class ContinuousScheduler:
         self._paged = bool(getattr(program, "paged", False))
         self._chunks = int(getattr(program, "num_prefill_chunks", 1))
         self._spec = int(getattr(program, "spec_tokens", 0))
+        self._insert_pages = bool(getattr(program, "insert_pages",
+                                          False))
+        self._kvpos = getattr(program, "kv_prefix_positions", None)
         if self._paged:
             self._alloc = PageAllocator(program.pool_pages)
             self._P = int(program.pages_per_seq)
@@ -281,6 +300,19 @@ class ContinuousScheduler:
                                         daemon=True)
         self._thread.start()
 
+    # -- insert dispatch ---------------------------------------------------
+
+    def _insert(self, state, j: int, rs, pages: List[int]):
+        """One compiled insert, routed by the program's capability: an
+        ``insert_pages`` program scatters the prompt KV through the
+        slot's page row (sentinel-filled past the allocation, so padded
+        prompt rows drop OOB instead of landing in shared pages)."""
+        if self._insert_pages:
+            row = np.full((self._P,), self._sentinel, np.int32)
+            row[:len(pages)] = pages
+            return self._program.insert(state, np.int32(j), rs, row)
+        return self._program.insert(state, np.int32(j), rs)
+
     # -- warmup ------------------------------------------------------------
 
     def _warm(self) -> None:
@@ -301,7 +333,7 @@ class ContinuousScheduler:
                 rs = carry
             else:
                 rs = prog.prefill(params, feed)
-            state = prog.insert(state, np.int32(0), rs)
+            state = self._insert(state, 0, rs, [])
             tok = np.full((self._S,), prog.bos_id, np.int32)
             tz = np.zeros((self._S,), np.int32)
             pages = self._pages.copy() if self._paged else None
@@ -321,7 +353,7 @@ class ContinuousScheduler:
             # from the fresh init_state leaves the first insert saw —
             # without this, the first live retire-and-refill pays one
             # serve-time compile
-            state = prog.insert(state, np.int32(0), rs)
+            state = self._insert(state, 0, rs, [])
             if self._prefix is not None:
                 # the copy-on-write page copy joins the closed
                 # signature set: warmed against the post-insert state
@@ -435,10 +467,12 @@ class ContinuousScheduler:
             # is the decode phase of the request timeline
             req.rec.mark("decode")
             req.rec.kv_pages = len(pages)
-        self._state = self._program.insert(self._state, np.int32(j), rs)
+        self._state = self._insert(self._state, j, rs, pages)
         slot = _Slot(req, req.max_new_tokens, pages)
         slot.key = key
         slot.entry = entry
+        if self._kvpos is not None:
+            slot.base = int(self._kvpos(req.feed))
         if self._prefix is not None:
             # kept so the retiring sequence can be cached (the entry's
             # prefill state); dropped at retire either way
@@ -490,15 +524,25 @@ class ContinuousScheduler:
         eos = prog.eos_id
         if eos in toks[:n_replay]:
             n_replay = toks.index(eos) + 1
-        full = (n_replay == cap) or (toks[n_replay - 1] == eos)
+        # an IMPORTED entry (disaggregation: externally-prefilled
+        # request state, no decoded tokens yet) replays nothing — it
+        # exists purely to skip the local prefill, so n_replay may be 0
+        full = (n_replay == cap) or (n_replay > 0
+                                     and toks[n_replay - 1] == eos)
         skipped = (int(prog.prefill_tokens(req.feed))
                    if hasattr(prog, "prefill_tokens") else 0)
+        base = (int(self._kvpos(req.feed))
+                if self._kvpos is not None else 0)
         if not full:
             # continuation: map the cached FULL pages read-only, COW
-            # the boundary page, own fresh pages for the rest
+            # the boundary page, own fresh pages for the rest. Sharing
+            # is accounted in decode-buffer POSITIONS (prompt prefix +
+            # replayed tokens), not tokens — for an encoder-decoder
+            # program base == 0 and the two coincide
             p_need = prog.pages_needed(cap)
-            shared_full = n_replay // self._ps
-            partial = (n_replay % self._ps) != 0
+            shared_pos = min(int(entry.positions), base + n_replay)
+            shared_full = shared_pos // self._ps
+            partial = (shared_pos % self._ps) != 0
             # pin FIRST: the fresh-page grant below may evict LRU
             # cache entries to make room, and the entry being mapped
             # must never be its own eviction victim
@@ -560,13 +604,35 @@ class ContinuousScheduler:
             self._activate(j, req, shared + fresh, entry.request_state,
                            key=key, entry=entry, replay=toks[:n_replay])
         # the replayed tokens are client-visible NOW — TTFT is the
-        # map latency, not a prefill + first decode step
-        now = time.perf_counter()
-        req.t_first_token = now
-        self._ttft.record((now - req.t_enqueue) * 1e3)
-        if rec is not None:
-            rec.first_token(now)
+        # map latency, not a prefill + first decode step. An imported
+        # entry replays NOTHING (it only skipped the prefill): no
+        # token is visible yet, so TTFT waits for the first decode
+        # step's _emit
+        if n_replay > 0:
+            now = time.perf_counter()
+            req.t_first_token = now
+            self._ttft.record((now - req.t_enqueue) * 1e3)
+            if rec is not None:
+                rec.first_token(now)
         return "activated", None
+
+    def import_prefix(self, tenant, key, request_state,
+                      positions: int = 0) -> bool:
+        """Install an EXTERNALLY-prefilled request state (the
+        disaggregation import path, serve/disagg.py) as a page-less
+        prefix-cache entry: ``tokens=[]`` / ``pages=[]``, so a matching
+        admission takes the hit path with ``n_replay == 0`` — it skips
+        the local prefill entirely and the insert re-scatters the
+        prompt KV from ``request_state`` into freshly-owned pages.
+        Thread-safe (the radix cache locks internally); returns False
+        when a longer local entry already covers the key (which is
+        strictly better — nothing to do)."""
+        if self._prefix is None:
+            raise ValueError(
+                "import_prefix requires ServeConfig.prefix_cache "
+                "(the radix index is the import surface)")
+        return self._prefix.insert(tenant, key, [], [], request_state,
+                                   positions=positions)
 
     def _refill(self) -> None:
         """Unchunked path: fill free slots from the queue, one whole
@@ -688,10 +754,12 @@ class ContinuousScheduler:
             slot.entry = None
         if (cache and self._prefix is not None and slot.key is not None
                 and slot.t > 0 and slot.pages):
-            used = min(-(-int(slot.t) // self._ps), len(slot.pages))
+            pos = slot.base + int(slot.t)
+            used = min(-(-pos // self._ps), len(slot.pages))
             self._prefix.insert(getattr(slot.req, "tenant", None),
                                 slot.key, slot.tokens,
-                                slot.pages[:used], slot.rs)
+                                slot.pages[:used], slot.rs,
+                                positions=pos)
             tail = slot.pages[used:]
             if tail:
                 self._alloc.free(tail)

@@ -431,7 +431,8 @@ class ServeFleet:
                max_new_tokens: Optional[int] = None,
                tenant: Any = None,
                slo_class: Optional[str] = None,
-               variant: Optional[str] = None) -> FleetRequest:
+               variant: Optional[str] = None,
+               rec: Optional[reqtrace.RequestRecord] = None) -> FleetRequest:
         """Admit one request to the fleet; returns its
         :class:`FleetRequest` future. Sheds with ``ServeOverloaded``
         only when EVERY placeable replica sheds; raises
@@ -442,7 +443,11 @@ class ServeFleet:
         ``variant`` constrains placement to replicas currently serving
         that model variant (:meth:`assign_variants`) — failover hops
         respect the same constraint, so a request never lands on the
-        wrong weights."""
+        wrong weights. ``rec`` carries an EXISTING lifecycle record
+        into this fleet (disaggregated serving: the record opened at
+        the front door already holds the prefill + kv_transfer phases;
+        this fleet's hops accumulate onto it instead of opening a
+        fresh one)."""
         if self._closed:
             raise ServeClosed("fleet is closed")
         if variant is not None and variant not in self._variants:
@@ -464,7 +469,14 @@ class ServeFleet:
         freq = FleetRequest(feed, deadline, max_new_tokens,
                             tenant=tenant, slo_class=slo_class,
                             variant=variant)
-        if obs_state.enabled:
+        if rec is not None:
+            freq.rec = rec
+            # a carried record means the request entered the SYSTEM
+            # earlier (disaggregated front door): client-side
+            # latency/TTFT must span the prefill + transfer phases
+            # already spent, not restart at this pool's door
+            freq.t_enqueue = rec.t0
+        elif obs_state.enabled:
             freq.rec = reqtrace.RequestRecord(
                 freq.id, t0=freq.t_enqueue, deadline=deadline,
                 ring=self.reqtrace, fleet_owned=True)
@@ -486,6 +498,42 @@ class ServeFleet:
                 else f"failed:{type(e).__name__}"))
             raise
         return freq
+
+    # -- direct placement (disaggregated prefill pool, ISSUE 19) -----------
+
+    def acquire_replica(self, exclude: Tuple = (),
+                        require=None) -> ReplicaHandle:
+        """Reserve one placeable replica for DIRECT (non-queued) work —
+        the disaggregated prefill pool runs ``prefill_only`` on the
+        caller's thread instead of going through :meth:`submit`. The
+        handle counts as a racing placement until
+        :meth:`release_replica` (hot-swap rotation waits on it); raises
+        ``ReplicaUnavailable`` when nothing is placeable."""
+        return self._router.place(tuple(exclude), require=require)
+
+    def release_replica(self, handle: ReplicaHandle) -> None:
+        """Release a :meth:`acquire_replica` reservation."""
+        self._router.done_placing(handle)
+
+    def record_replica_success(self, handle: ReplicaHandle,
+                               latency_ms: float = 0.0) -> None:
+        """Feed one direct-work success into the router's health
+        probes (the same per-request accounting submit-path work
+        gets)."""
+        self._router.record_success(handle, latency_ms=latency_ms)
+
+    def record_replica_error(self, handle: ReplicaHandle,
+                             exc: BaseException) -> None:
+        """Feed one direct-work failure into the router's error-rate
+        window (deadline expiries excepted — shedding on time is the
+        contract working)."""
+        self._record_request_error(handle.rid, exc)
+
+    def live_sessions(self) -> List[Tuple[Any, Any]]:
+        """``(rid, session)`` for every live, non-ejected replica —
+        the disaggregation layer's import-broadcast surface."""
+        return [(h.rid, h.session) for h in self._router.handles()
+                if not h.dead and h.state != EJECTED]
 
     def _untrack(self, freq: FleetRequest,
                  outcome: Optional[str] = None) -> None:

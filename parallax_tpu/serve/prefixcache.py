@@ -85,9 +85,10 @@ class CacheEntry:
     (device arrays, kept alive by this reference)."""
 
     __slots__ = ("tenant", "key", "tokens", "pages", "request_state",
-                 "mappers", "last_use", "_node")
+                 "mappers", "last_use", "positions", "_node")
 
-    def __init__(self, tenant, key, tokens, pages, request_state):
+    def __init__(self, tenant, key, tokens, pages, request_state,
+                 positions=None):
         self.tenant = tenant
         self.key: Tuple[int, ...] = tuple(int(t) for t in key)
         self.tokens: List[int] = [int(t) for t in tokens]
@@ -95,6 +96,14 @@ class CacheEntry:
         self.request_state = request_state
         self.mappers = 0          # in-flight sequences mapping these pages
         self.last_use = 0
+        # decode-buffer POSITIONS the pages hold valid KV for. For an
+        # encoder-decoder program positions == len(tokens); a decoder-
+        # only program's prompt occupies the buffer ahead of the
+        # decoded tokens, so positions > len(tokens); an IMPORTED
+        # entry (disaggregation) carries request_state only — no
+        # pages, positions == 0
+        self.positions: int = (len(self.tokens) if positions is None
+                               else int(positions))
         self._node: Optional[_Node] = None
 
     @property
@@ -108,6 +117,7 @@ class CacheEntry:
     def snapshot(self) -> Dict[str, Any]:
         return {"tenant": self.tenant, "key_len": len(self.key),
                 "tokens": len(self.tokens), "pages": len(self.pages),
+                "positions": self.positions,
                 "mappers": self.mappers, "last_use": self.last_use}
 
 
@@ -195,13 +205,15 @@ class RadixPrefixCache:
             return entry
 
     def insert(self, tenant, key: Sequence[int], tokens: Sequence[int],
-               pages: Sequence[int], request_state) -> bool:
+               pages: Sequence[int], request_state,
+               positions: Optional[int] = None) -> bool:
         """Cache a completed sequence. TAKES OWNERSHIP of one allocator
         reference per page in ``pages`` (the caller transfers the
         retiring slot's refs instead of freeing them). If an entry with
         at least as many decoded tokens already exists under the key,
         the offered pages are released and the existing entry wins
-        (longest-continuation-wins keeps replay maximal). Returns True
+        (longest-continuation-wins keeps replay maximal; an IMPORTED
+        zero-token entry never displaces a real one). Returns True
         when the offered entry was installed."""
         key_t = tuple(int(t) for t in key)
         with self._lock:
@@ -212,7 +224,7 @@ class RadixPrefixCache:
                 old.last_use = next(self._clock)
                 return False
             entry = CacheEntry(tenant, key_t, tokens, pages,
-                               request_state)
+                               request_state, positions=positions)
             entry.last_use = next(self._clock)
             entry._node = node
             node.entry = entry
@@ -238,6 +250,30 @@ class RadixPrefixCache:
             if entry.mappers < 1:
                 raise ValueError("unpin without a matching pin")
             entry.mappers -= 1
+
+    # -- cross-replica export bracket (disaggregation, ISSUE 19) -----------
+
+    def begin_transfer(self, entry: CacheEntry) -> None:
+        """Bracket the start of a cross-replica export: pins the entry
+        (LRU eviction skips it) AND takes one allocator ref per page —
+        a supersede by a longer continuation drops only the CACHE's
+        refs, so without the extra ref a page streaming over the wire
+        (including a COW boundary page) could return to the pool and
+        be rewritten mid-transfer. Pair with :meth:`end_transfer`."""
+        with self._lock:
+            entry.mappers += 1
+            entry.last_use = next(self._clock)
+            self._alloc.share(entry.pages)
+
+    def end_transfer(self, entry: CacheEntry) -> None:
+        """Release the transfer pin + page refs taken by
+        :meth:`begin_transfer`."""
+        with self._lock:
+            if entry.mappers < 1:
+                raise ValueError(
+                    "end_transfer without a matching begin_transfer")
+            entry.mappers -= 1
+            self._alloc.free(entry.pages)
 
     # -- eviction ----------------------------------------------------------
 

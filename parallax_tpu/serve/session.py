@@ -399,6 +399,62 @@ class ServeSession:
             return None
         return self._scheduler.prefix_stats()
 
+    # -- disaggregated prefill/decode (ISSUE 19, serve/disagg.py) ----------
+
+    def prefill_only(self, feed: Dict[str, Any]):
+        """Run ONLY the prefill for one request, on the CALLER's thread
+        — the disaggregated prefill pool's work unit. Returns
+        ``(prepared_feed, prefix_key, request_state)``: the feed padded
+        onto the program's fixed shapes, the radix key the result is
+        cacheable under, and the prefill request state (device arrays —
+        :func:`~parallax_tpu.serve.disagg.export_prefill` turns them
+        into wire bytes). Rides the SAME jitted prefill the scheduler
+        warmed at construction (identical single-request signature), so
+        it never compiles at serve time; jit dispatch is thread-safe
+        against the concurrently-running decode loop."""
+        if self._scheduler is None:
+            raise ValueError(
+                "prefill_only requires continuous-decode mode "
+                "(program=...)")
+        prog = self._scheduler._program
+        if not hasattr(prog, "prefix_key"):
+            raise ValueError(
+                "prefill_only requires a program exposing prefix_key "
+                "(the transfer protocol is keyed by it)")
+        if self._faults is not None:
+            # chaos hook: an armed crash on this replica fires on the
+            # prefill path too (the disagg kill-mid-transfer case)
+            self._faults.on_dispatch(self.replica_id)
+        if not self._scheduler.alive:
+            raise ReplicaUnavailable(
+                f"prefill replica {self.replica_id!r} is dead")
+        prepared = prog.prepare_feed(feed)
+        chunks = int(getattr(prog, "num_prefill_chunks", 1))
+        with trace.span("serve.prefill_export", chunks=chunks):
+            if chunks > 1:
+                carry = prepared
+                for k in range(chunks):
+                    carry = prog.prefill_chunk(self._params, carry, k)
+                rs = carry
+            else:
+                rs = prog.prefill(self._params, prepared)
+            jax.block_until_ready(jax.tree_util.tree_leaves(rs))
+        return prepared, prog.prefix_key(prepared), rs
+
+    def import_prefix_entry(self, tenant, key, request_state,
+                            positions: int = 0) -> bool:
+        """Install an externally-prefilled request state into this
+        replica's prefix cache (the decode side of the page-transfer
+        protocol); see
+        :meth:`~parallax_tpu.serve.continuous.ContinuousScheduler.
+        import_prefix`. Thread-safe."""
+        if self._scheduler is None:
+            raise ValueError(
+                "import_prefix_entry requires continuous-decode mode "
+                "(program=...)")
+        return self._scheduler.import_prefix(tenant, key, request_state,
+                                             positions=positions)
+
     def _make_one_shot_request(self, feed, deadline, tenant=None,
                                slo_rank: int = 0) -> Request:
         feed = {k: np.asarray(v) for k, v in feed.items()}

@@ -1,0 +1,172 @@
+"""DecodeProgram conformance rig (ISSUE 19): every registered adapter
+passes one battery.
+
+The serving subsystem is model-agnostic through the DecodeProgram
+contract; this file is the contract's enforcement. It parametrizes
+over ``registered_adapters()`` so a new adapter gets the full battery
+from its ``register_adapter`` call with zero new test code:
+
+  * paged-vs-dense bit-identity — the paged KV layout is an exact
+    re-layout, not an approximation;
+  * chunked-prefill identity — layer-chunked prefill composes to the
+    same prefix state as one-shot prefill;
+  * exact-under-greedy through the scheduler — tokens served through
+    ServeSession (slot scatter, continuous refill) match
+    ``standalone_greedy`` bit-for-bit, with zero serve-time recompiles
+    against the warmed signature set;
+  * retire/refill page hygiene — more requests than slots forces
+    mid-flight refill, and after drain the pool reports zero pages in
+    use (no leak across the retire -> refill boundary).
+
+Fixture builds are the expensive part (each one jits prefill + step),
+so they are shared per (adapter, layout) via an lru_cache; tests never
+mutate params.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import parallax_tpu as parallax
+from parallax_tpu import ServeConfig
+from parallax_tpu.serve import (ServeSession, registered_adapters,
+                                standalone_greedy)
+
+ADAPTERS = registered_adapters()
+NAMES = sorted(ADAPTERS)
+PAGED_NAMES = sorted(n for n in NAMES if ADAPTERS[n].paged)
+CHUNKED_NAMES = sorted(n for n in NAMES if ADAPTERS[n].chunked)
+
+
+@functools.lru_cache(maxsize=None)
+def _build(name: str, paged: bool, chunked: bool):
+    spec = ADAPTERS[name]
+    return spec.build(paged=paged, chunked=chunked)
+
+
+def _feeds(name: str, n: int, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    return [ADAPTERS[name].make_feed(rng) for _ in range(n)]
+
+
+def _serve_config(spec, max_batch: int = 3):
+    return parallax.Config(serve_config=ServeConfig(
+        max_batch=max_batch, max_queue=64, prefix_cache=spec.paged))
+
+
+# -- layout identities (device math only, no scheduler) --------------------
+
+
+@pytest.mark.parametrize("name", PAGED_NAMES)
+def test_paged_vs_dense_bit_identity(name):
+    spec = ADAPTERS[name]
+    prog_p, params_p = _build(name, True, False)
+    prog_d, params_d = _build(name, False, False)
+    for feed in _feeds(name, 3):
+        got_p = standalone_greedy(prog_p, params_p, feed,
+                                  max_new_tokens=6)
+        got_d = standalone_greedy(prog_d, params_d, feed,
+                                  max_new_tokens=6)
+        assert got_p == got_d, (name, got_p, got_d)
+
+
+@pytest.mark.parametrize("name", CHUNKED_NAMES)
+def test_chunked_prefill_bit_identity(name):
+    prog_c, params_c = _build(name, True, True)
+    prog_1, params_1 = _build(name, True, False)
+    assert prog_c.num_prefill_chunks > 1
+    for feed in _feeds(name, 3):
+        got_c = standalone_greedy(prog_c, params_c, feed,
+                                  max_new_tokens=6)
+        got_1 = standalone_greedy(prog_1, params_1, feed,
+                                  max_new_tokens=6)
+        assert got_c == got_1, (name, got_c, got_1)
+
+
+# -- exact-under-greedy through the scheduler ------------------------------
+
+
+@pytest.mark.parametrize("name", NAMES)
+def test_served_tokens_match_standalone_greedy(name):
+    """5 requests through a 3-slot session: forces retire + refill, and
+    every emitted stream must equal the standalone reference. Zero
+    recompiles (the rig reuses the warmed program instance — standalone
+    S=1 traces are separate jit entries, not serve-time compiles) and
+    zero pages still mapped after drain."""
+    spec = ADAPTERS[name]
+    prog, params = _build(name, spec.paged, False)
+    feeds = _feeds(name, 5, seed=11)
+    want = [standalone_greedy(prog, params, f, max_new_tokens=6)
+            for f in feeds]
+    sess = ServeSession(program=prog, params=params,
+                        config=_serve_config(spec))
+    try:
+        reqs = [sess.submit(f, max_new_tokens=6) for f in feeds]
+        got = [[int(t) for t in r.result(timeout=120)] for r in reqs]
+    finally:
+        sess.close()
+    assert got == want, (name, got, want)
+    snap = sess.metrics.snapshot()
+    assert snap["serve.recompiles"] == 0, (name, snap["serve.recompiles"])
+    if spec.paged:
+        assert snap["serve.kv_pages_in_use"] == 0, (
+            name, snap["serve.kv_pages_in_use"])
+
+
+@pytest.mark.parametrize("name", PAGED_NAMES)
+def test_prefix_replay_continuation_bit_identity(name):
+    """Same feed twice with a longer cap the second time: the second
+    request must take a prefix hit, replay the cached tokens, then
+    CONTINUE past them into fresh pages — and still match the
+    standalone stream bit-for-bit (positions-aware page sharing)."""
+    spec = ADAPTERS[name]
+    prog, params = _build(name, True, False)
+    feed = _feeds(name, 1, seed=13)[0]
+    want = standalone_greedy(prog, params, feed, max_new_tokens=6)
+    sess = ServeSession(program=prog, params=params,
+                        config=_serve_config(spec, max_batch=2))
+    try:
+        t1 = [int(t) for t in
+              sess.submit(feed, max_new_tokens=4).result(timeout=120)]
+        t2 = [int(t) for t in
+              sess.submit(feed, max_new_tokens=6).result(timeout=120)]
+    finally:
+        sess.close()
+    assert t1 == want[:len(t1)], (name, t1, want)
+    assert t2 == want, (name, t2, want)
+    snap = sess.metrics.snapshot()
+    assert snap["serve.prefix.hits"] >= 1
+    assert snap["serve.recompiles"] == 0
+    assert snap["serve.kv_pages_in_use"] == 0
+
+
+@pytest.mark.parametrize("name", PAGED_NAMES)
+def test_import_prefix_then_decode_bit_identity(name):
+    """The disaggregation building block at session scope: prefill_only
+    on one session, import the request state into ANOTHER session's
+    prefix cache (page-less entry, positions=0), then submit the same
+    feed there — the hit admits with zero replayed tokens, insert
+    re-scatters the prompt KV into fresh pages, and the stream matches
+    standalone exactly."""
+    spec = ADAPTERS[name]
+    prog, params = _build(name, True, False)
+    feed = _feeds(name, 1, seed=17)[0]
+    want = standalone_greedy(prog, params, feed, max_new_tokens=6)
+    cfg = _serve_config(spec, max_batch=2)
+    pre = ServeSession(program=prog, params=params, config=cfg)
+    dec = ServeSession(program=prog, params=params, config=cfg)
+    try:
+        prepared, key, rs = pre.prefill_only(feed)
+        assert dec.import_prefix_entry(None, key, rs, positions=0)
+        toks = [int(t) for t in
+                dec.submit(feed, max_new_tokens=6).result(timeout=120)]
+    finally:
+        pre.close()
+        dec.close()
+    assert toks == want, (name, toks, want)
+    snap = dec.metrics.snapshot()
+    assert snap["serve.prefix.hits"] == 1
+    assert snap["serve.prefix.replayed_tokens"] == 0
+    assert snap["serve.recompiles"] == 0
+    assert snap["serve.kv_pages_in_use"] == 0

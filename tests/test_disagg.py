@@ -1,0 +1,267 @@
+"""Disaggregated prefill/decode serving (ISSUE 19): the two-pool
+front door, the page-transfer wire protocol, and its failure ladder.
+
+Four layers of coverage:
+
+* the wire format as a PURE unit — export/import roundtrips a nested
+  request state exactly (dtype + shape + bits), and malformed states
+  (non-dict, '/'-bearing keys) are refused loudly at export;
+* the transfer pin (prefixcache ``begin_transfer``/``end_transfer``)
+  as a PURE unit — a transferring entry survives LRU pressure, and a
+  supersede-during-transfer cannot return the streaming pages to the
+  pool until the bracket closes;
+* the serving acceptance bar — disaggregated tokens are BIT-IDENTICAL
+  to the colocated fleet and to standalone greedy, with zero
+  serve-time recompiles across both pools, zero leaked decode pages,
+  and the ``kv_transfer``-extended TTFT decomposition summing to the
+  client-observed TTFT within 5%;
+* the failure ladder — a prefill replica killed mid-transfer fails
+  over inside the pool (all requests complete, ``prefill_failovers``
+  counts the hop), and a prefill pool with nothing placeable falls
+  back to colocated serving (identical tokens, ``prefill_fallbacks``
+  counts the degrade).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import parallax_tpu as parallax
+from parallax_tpu import ServeConfig
+from parallax_tpu.serve import (DisaggFleet, FaultInjector, FleetConfig,
+                                PageAllocator, RadixPrefixCache,
+                                ServeFleet, ServeSession,
+                                export_prefill, import_prefill,
+                                registered_adapters, standalone_greedy)
+from test_adapters import _build, _feeds
+
+SPEC = registered_adapters()["causal_lm"]
+
+
+def _mk_factory(prog, params):
+    cfg = parallax.Config(serve_config=ServeConfig(
+        max_batch=2, max_queue=64, prefix_cache=True))
+
+    def mk(rid, **kw):
+        return ServeSession(program=prog, params=params, config=cfg,
+                            **kw)
+    return mk
+
+
+# -- the wire protocol as a pure unit ---------------------------------------
+
+
+class TestWireProtocol:
+    def test_roundtrip_nested_exact(self):
+        import jax.numpy as jnp
+        rs = {"pk": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+              "meta": {"base": np.int32(5),
+                       "mask": np.array([True, False])},
+              "first": np.arange(3, dtype=np.int32)}
+        wire = export_prefill(rs)
+        assert isinstance(wire, bytes) and len(wire) > 0
+        back = import_prefill(wire)
+        assert set(back) == {"pk", "meta", "first"}
+        assert set(back["meta"]) == {"base", "mask"}
+        np.testing.assert_array_equal(back["pk"], np.asarray(rs["pk"]))
+        np.testing.assert_array_equal(back["meta"]["base"], 5)
+        np.testing.assert_array_equal(back["meta"]["mask"],
+                                      [True, False])
+        assert back["pk"].dtype == np.float32
+        assert back["first"].dtype == np.int32
+
+    def test_slash_key_refused(self):
+        with pytest.raises(ValueError, match="separator"):
+            export_prefill({"a/b": np.zeros(2)})
+
+    def test_non_dict_state_refused(self):
+        with pytest.raises(ValueError, match="dict of arrays"):
+            export_prefill(np.zeros(2))
+
+
+# -- the transfer pin as a pure unit ----------------------------------------
+
+
+class TestTransferPin:
+    def _entry(self, cache, alloc, key, tokens, n_pages):
+        pages = alloc.alloc(n_pages)
+        assert cache.insert(None, key, tokens, pages,
+                            {"x": np.zeros(1)})
+        return cache.lookup(None, key)
+
+    def test_transferring_entry_survives_lru_pressure(self):
+        a = PageAllocator(8)
+        c = RadixPrefixCache(a)
+        streaming = self._entry(c, a, (1,), [9], 4)
+        self._entry(c, a, (2,), [9], 4)
+        c.begin_transfer(streaming)
+        # pool exhausted; eviction may only take the unpinned entry
+        assert c.evict_for(4) == 1
+        assert c.lookup(None, (1,)) is streaming, \
+            "a transferring entry must never be the LRU victim"
+        assert c.lookup(None, (2,)) is None
+        c.end_transfer(streaming)
+        assert c.evict_for(8) == 1
+        assert a.in_use == 0
+
+    def test_supersede_cannot_free_transferring_pages(self):
+        """The satellite-6 pin: begin_transfer takes a page REF, so a
+        longer continuation superseding the entry mid-stream drops only
+        the cache's refs — the bytes on the wire keep their backing
+        pages until end_transfer."""
+        a = PageAllocator(8)
+        c = RadixPrefixCache(a)
+        streaming = self._entry(c, a, (1,), [7, 8], 4)
+        c.begin_transfer(streaming)
+        assert a.shared_pages == 4
+        # a longer continuation of the same key supersedes mid-stream
+        pages2 = a.alloc(4)
+        assert c.insert(None, (1,), [7, 8, 9], pages2,
+                        {"x": np.zeros(1)})
+        assert c.lookup(None, (1,)) is not streaming
+        assert a.in_use == 8, \
+            "superseded-but-transferring pages must stay allocated"
+        c.end_transfer(streaming)
+        assert a.in_use == 4, \
+            "end_transfer releases the transfer refs"
+        assert c.evict_for(8) == 1
+        assert a.in_use == 0
+
+    def test_unbalanced_end_transfer_refused(self):
+        a = PageAllocator(4)
+        c = RadixPrefixCache(a)
+        e = self._entry(c, a, (1,), [5], 2)
+        with pytest.raises(ValueError, match="begin_transfer"):
+            c.end_transfer(e)
+
+
+# -- serving acceptance: bit-identity + TTFT decomposition ------------------
+
+
+class TestDisaggServing:
+    def test_bit_identical_to_colocated_with_kv_transfer_decomp(self):
+        prog, params = _build("causal_lm", True, False)
+        mk = _mk_factory(prog, params)
+        feeds = _feeds("causal_lm", 5, seed=21)
+        want = [standalone_greedy(prog, params, f, max_new_tokens=6)
+                for f in feeds]
+
+        colo = ServeFleet(mk, config=FleetConfig(num_replicas=1,
+                                                 min_replicas=1))
+        try:
+            got_colo = [[int(t) for t in r.result(timeout=120)]
+                        for r in [colo.submit(f, max_new_tokens=6)
+                                  for f in feeds]]
+        finally:
+            colo.close()
+        assert got_colo == want
+
+        d = DisaggFleet(
+            mk, mk,
+            prefill_config=FleetConfig(num_replicas=1, min_replicas=1),
+            decode_config=FleetConfig(num_replicas=1, min_replicas=1))
+        try:
+            reqs = [d.submit(f, max_new_tokens=6) for f in feeds]
+            got = [[int(t) for t in r.result(timeout=120)]
+                   for r in reqs]
+        finally:
+            d.close()
+        assert got == want, "disaggregated must be bit-identical"
+
+        snap = d.metrics.snapshot()
+        assert snap["serve.disagg.transfers"] == len(feeds)
+        assert snap["serve.disagg.transfer_bytes"] > 0
+        assert d.recompiles() == 0
+        # every decode replica drained back to zero mapped pages
+        for rid, st in d.decode_fleet.stats()["replicas"].items():
+            assert st["serve"].get("serve.kv_pages_in_use") == 0, rid
+
+        # TTFT decomposition: the kv_transfer phase appears and the
+        # phase sum still partitions the client-observed TTFT
+        recs = [r for r in d.request_records()
+                if r.get("ttft_decomp") is not None]
+        assert recs, "front-door records must carry decompositions"
+        for rec in recs:
+            decomp = rec["ttft_decomp"]
+            assert "kv_transfer_ms" in decomp, decomp
+            total = sum(decomp.values())
+            ttft = rec["ttft_ms"]
+            assert abs(total - ttft) <= 0.05 * max(ttft, 1e-9), \
+                (total, ttft, decomp)
+
+    def test_prefill_replica_killed_mid_transfer_fails_over(self):
+        """The chaos case: one of two prefill replicas dies inside the
+        prefill/export path; every request still completes with
+        identical tokens via a counted failover hop."""
+        prog, params = _build("causal_lm", True, False)
+        mk = _mk_factory(prog, params)
+        feeds = _feeds("causal_lm", 4, seed=23)
+        want = [standalone_greedy(prog, params, f, max_new_tokens=6)
+                for f in feeds]
+        inj = FaultInjector()
+        d = DisaggFleet(
+            mk, mk,
+            prefill_config=FleetConfig(num_replicas=2, min_replicas=1,
+                                       max_retries=2),
+            decode_config=FleetConfig(num_replicas=1, min_replicas=1),
+            faults=inj)
+        try:
+            # warm one request end to end first
+            assert [int(t) for t in
+                    d.submit(feeds[0],
+                             max_new_tokens=6).result(timeout=120)] \
+                == want[0]
+            # park replica 0's idle decode loop inside an injected
+            # stall so the one-shot crash is consumed by the PREFILL
+            # path (the mid-transfer kill), not by an idle tick
+            inj.arm(0, "stall", seconds=2.0)
+            t_end = time.perf_counter() + 2.0
+            while inj.fired("stall") == 0 \
+                    and time.perf_counter() < t_end:
+                time.sleep(0.005)
+            assert inj.fired("stall") == 1
+            inj.arm(0, "crash")
+            reqs = [d.submit(f, max_new_tokens=6) for f in feeds]
+            got = [[int(t) for t in r.result(timeout=120)]
+                   for r in reqs]
+        finally:
+            d.close()
+        assert got == want, "failover must not change a single token"
+        assert inj.fired("crash") == 1
+        snap = d.metrics.snapshot()
+        assert snap["serve.disagg.prefill_failovers"] >= 1, snap
+        assert d.recompiles() == 0
+
+    def test_dead_prefill_pool_falls_back_to_colocated(self):
+        """Bottom of the failure ladder: nothing placeable in the
+        prefill pool degrades to colocated serving — the decode
+        replica's admission misses the cache and runs the prefill
+        locally, tokens unchanged."""
+        prog, params = _build("causal_lm", True, False)
+        mk = _mk_factory(prog, params)
+        feeds = _feeds("causal_lm", 3, seed=29)
+        want = [standalone_greedy(prog, params, f, max_new_tokens=6)
+                for f in feeds]
+        inj = FaultInjector()
+        d = DisaggFleet(
+            mk, mk,
+            prefill_config=FleetConfig(num_replicas=1, min_replicas=1),
+            decode_config=FleetConfig(num_replicas=1, min_replicas=1),
+            faults=inj)
+        try:
+            inj.arm(0, "crash")  # the idle tick takes it: replica dies
+            t_end = time.perf_counter() + 5.0
+            while d.prefill_fleet.live_sessions() \
+                    and time.perf_counter() < t_end:
+                time.sleep(0.01)
+            assert not d.prefill_fleet.live_sessions()
+            reqs = [d.submit(f, max_new_tokens=6) for f in feeds]
+            got = [[int(t) for t in r.result(timeout=120)]
+                   for r in reqs]
+        finally:
+            d.close()
+        assert got == want, "the fallback path must be bit-identical"
+        snap = d.metrics.snapshot()
+        assert snap["serve.disagg.prefill_fallbacks"] == len(feeds)
+        assert snap["serve.disagg.transfers"] == 0

@@ -292,6 +292,16 @@ SECONDARY_GATES = (
     # and the measured 1F1B/GPipe schedule are coming apart)
     ("tune.pp_trial.predicted_over_measured", False),
     ("tune.pp_trial.predicted_over_measured", True),
+    # disaggregated serving (ISSUE 19, bench "serve.disagg" block):
+    # the disaggregated arm's client-observed TTFT tail and its
+    # throughput over the mixed-regime stream — the two SLOs the
+    # prefill/decode split exists to protect. Absolutes are
+    # CPU-relative (the 'wire' is a host memcpy on the CPU rig);
+    # cross-round drift is the signal: a creeping ttft_ms_p99 means
+    # the prefill/transfer path got slower, a falling tokens_per_sec
+    # means the decode pool did
+    ("serve.disagg.ttft_ms_p99", False),
+    ("serve.disagg.tokens_per_sec", True),
 )
 
 

@@ -40,6 +40,13 @@ the declared length buckets):
   mixed-length decode load with retire/refill and page churn the
   ``jax.monitoring`` compile listener must stay at ZERO and every
   request must complete.
+* **per-adapter conformance** (ISSUE 19) — EVERY registered
+  DecodeProgram (``parallax_tpu.serve.registered_adapters``: the NMT
+  encoder-decoder, the causal LM, the MoE-LM, the lm1b LSTM) serves a
+  small mixed load with zero serve-time compiles and zero KV pages
+  still mapped after drain — the closure/hygiene half of the
+  model-agnostic contract (bit-identity lives in
+  tests/test_adapters.py).
 """
 
 from __future__ import annotations
@@ -234,6 +241,47 @@ def measure(n_requests: int = 96, concurrency: int = 4,
         "prefill_chunks": dstats.get("serve.prefill_chunks"),
     }
 
+    # -- phase 4: per-adapter conformance (ISSUE 19) -------------------
+    # every registered DecodeProgram serves a small mixed load with
+    # zero serve-time compiles (its per-adapter signature closure
+    # held) and zero pages mapped after drain (retire/refill hygiene)
+    from parallax_tpu.serve import registered_adapters
+
+    adapters = {}
+    for name, spec in sorted(registered_adapters().items()):
+        prog, params = spec.build(paged=spec.paged, chunked=False)
+        acfg = parallax.Config(serve_config=parallax.ServeConfig(
+            max_batch=3, max_queue=64, prefix_cache=spec.paged))
+        asess = parallax.ServeSession(program=prog, params=params,
+                                      config=acfg)
+
+        def afeed(i, _spec=spec):
+            # fresh per-i generator: thread-safe and replayable
+            return _spec.make_feed(np.random.default_rng(5000 + i))
+
+        try:
+            _compile_events["n"] = 0
+            _compile_events["active"] = True
+            arep = loadgen.run_load(asess, afeed, 9, concurrency=3,
+                                    max_new_tokens=6)
+            _compile_events["active"] = False
+            a_compiles = _compile_events["n"]
+            astats = asess.stats()
+        finally:
+            asess.close()
+        adapters[name] = {
+            "completed": arep["completed"],
+            "failed": arep["failed"],
+            "tokens": arep["tokens"],
+            "serve_time_xla_compiles": a_compiles,
+            "recompiles": astats.get("serve.recompiles", 0),
+            # after close: retired pages transferred to the prefix
+            # cache were released by the drain too
+            "kv_pages_in_use_after":
+                (asess.metrics.snapshot().get("serve.kv_pages_in_use")
+                 if spec.paged else 0),
+        }
+
     def _p50(h):
         return h["p50"] if isinstance(h, dict) else None
 
@@ -261,6 +309,7 @@ def measure(n_requests: int = 96, concurrency: int = 4,
                                  if measured is not None else None),
         "batch_occupancy": stats.get("serve.batch_occupancy"),
         "decode": decode,
+        "adapters": adapters,
         "burst": {
             "submitted": burst["submitted"],
             "shed": burst["shed"],
@@ -317,6 +366,20 @@ def check(result: dict, max_overhead: float = 0.05) -> list:
     if d.get("kv_pages_in_use_after", 0) != 0:
         bad.append(f"{d['kv_pages_in_use_after']} KV page(s) leaked "
                    f"after all decode sequences retired")
+    for name, a in sorted((result.get("adapters") or {}).items()):
+        if a.get("recompiles", 0) != 0 \
+                or a.get("serve_time_xla_compiles", 0) != 0:
+            bad.append(f"adapter {name!r}: serve-time compile(s) "
+                       f"fired (recompiles={a.get('recompiles')}, "
+                       f"xla={a.get('serve_time_xla_compiles')}) — "
+                       f"its signature closure leaked")
+        if a.get("completed", 0) == 0 or a.get("failed", 0):
+            bad.append(f"adapter {name!r} load did not complete "
+                       f"cleanly: {a}")
+        if a.get("kv_pages_in_use_after") not in (0, None):
+            bad.append(f"adapter {name!r} leaked "
+                       f"{a['kv_pages_in_use_after']} KV page(s) "
+                       f"after drain")
     return bad
 
 

@@ -51,7 +51,9 @@ def run_load(session, make_feed, n_requests: int, concurrency: int = 4,
     threads; ``make_feed(i)`` builds request ``i``'s feed. Returns the
     outcome/latency report (shed and timed-out requests are counted,
     not errors). ``submit_kw`` (e.g. ``{"tenant": "a"}``) is forwarded
-    to every ``session.submit``."""
+    to every ``session.submit``. ``max_new_tokens`` may be a CALLABLE
+    ``i -> int`` (per-request decode budgets — the mixed-regime rig's
+    short-decode/long-decode split rides this)."""
     from parallax_tpu.serve import (DeadlineExceeded, ServeClosed,
                                     ServeOverloaded)
 
@@ -72,10 +74,12 @@ def run_load(session, make_feed, n_requests: int, concurrency: int = 4,
                 if i >= n_requests:
                     return
                 counter["next"] = i + 1
+            mnt = (max_new_tokens(i) if callable(max_new_tokens)
+                   else max_new_tokens)
             try:
                 req = session.submit(make_feed(i),
                                      deadline_ms=deadline_ms,
-                                     max_new_tokens=max_new_tokens,
+                                     max_new_tokens=mnt,
                                      **submit_kw)
             except ServeOverloaded:
                 with lock:
@@ -121,11 +125,13 @@ def run_load(session, make_feed, n_requests: int, concurrency: int = 4,
         "wall_s": round(wall, 3),
         "qps": round(outcomes["completed"] / wall, 2) if wall > 0 else None,
         "latency_ms": {"p50": _pct(lat_ms, 0.50), "p95": _pct(lat_ms, 0.95),
+                       "p99": _pct(lat_ms, 0.99),
                        "max": round(lat_ms[-1], 3) if lat_ms else None},
         # time-to-first-token, measured CLIENT-side per request (equals
         # full latency in one-shot mode, where the only token is the
         # result)
         "ttft_ms": {"p50": _pct(ttft_ms, 0.50), "p95": _pct(ttft_ms, 0.95),
+                    "p99": _pct(ttft_ms, 0.99),
                     "max": round(ttft_ms[-1], 3) if ttft_ms else None},
         "tokens": tokens[0],
         "tokens_per_sec": (round(tokens[0] / wall, 2)
@@ -210,6 +216,83 @@ def shared_prefix_feed(Ts: int = 8, vocab: int = 256,
         return {"src": r.integers(3, vocab, (L,)).astype(np.int32)}
 
     return make_feed
+
+
+def mixed_regime_feed(Ts: int = 8, vocab: int = 256,
+                      long_prefill_share: float = 0.5,
+                      short_decode: int = 2, long_decode: int = 8,
+                      key: str = "src", seed: int = 4000):
+    """The disaggregation traffic shape (ISSUE 19): a deterministic
+    mix of the two regimes that pull a colocated replica in opposite
+    directions — LONG-prefill/SHORT-decode requests (full-length
+    source, ``short_decode`` new tokens: the prefill-bound half) and
+    SHORT-prefill/LONG-decode requests (minimal source,
+    ``long_decode`` new tokens: the decode-bound half). Which regime
+    request ``i`` belongs to is a pure function of ``i``, so the
+    colocated and disaggregated arms of an A/B replay the EXACT same
+    request stream. Returns ``(make_feed, max_new_tokens)``; the
+    second is the ``i -> int`` callable ``run_load`` resolves per
+    request."""
+    import numpy as np
+
+    if not 0.0 <= float(long_prefill_share) <= 1.0:
+        raise ValueError(f"long_prefill_share must be in [0, 1], "
+                         f"got {long_prefill_share}")
+
+    def _regime(r):
+        # first draw from the per-i generator decides the regime, so
+        # make_feed and max_new_tokens agree without shared state
+        return r.random() < long_prefill_share
+
+    def make_feed(i):
+        r = np.random.default_rng(seed + i)
+        L = Ts if _regime(r) else max(2, Ts // 4)
+        return {key: r.integers(3, vocab, (L,)).astype(np.int32)}
+
+    def max_new_tokens(i):
+        r = np.random.default_rng(seed + i)
+        return short_decode if _regime(r) else long_decode
+
+    return make_feed, max_new_tokens
+
+
+def demo_disagg_rig(slots: int = 4, T: int = 12, Ts: int = 8,
+                    model_dim: int = 32, num_layers: int = 2,
+                    vocab: int = 64, page_size: int = 4,
+                    max_queue: int = 4096):
+    """The disaggregation A/B fixture (bench ``serve.disagg`` block
+    and tests): a paged f32 tiny-NMT decode program plus a replica
+    factory with the prefix cache ON (the import surface). Every
+    replica shares ONE program instance, so the colocated arm, the
+    prefill pool and the decode pool all ride the same jit caches.
+    Build the colocated arm as ``ServeFleet(make_replica, ...)`` and
+    the disaggregated arm as ``DisaggFleet(make_replica,
+    make_replica, ...)`` over the same :func:`mixed_regime_feed`
+    stream (feed key ``"src"``). Returns ``make_replica``."""
+    import jax
+    import jax.numpy as jnp
+
+    import parallax_tpu as parallax
+    from parallax_tpu.models import nmt
+    from parallax_tpu.serve import NMTDecodeProgram, ServeSession
+
+    cfg = nmt.tiny_config(vocab_size=vocab, model_dim=model_dim,
+                          num_heads=4, mlp_dim=2 * model_dim,
+                          num_layers=num_layers, max_len=max(T, Ts),
+                          num_partitions=1,
+                          compute_dtype=jnp.float32)
+    params = nmt.build_model(cfg).init_fn(jax.random.PRNGKey(0))
+    prog = NMTDecodeProgram(cfg, max_src_len=Ts, max_len=T,
+                            page_size=page_size,
+                            pool_pages=slots * (T // page_size))
+    pcfg = parallax.Config(serve_config=parallax.ServeConfig(
+        max_batch=slots, max_queue=max_queue, prefix_cache=True))
+
+    def make_replica(rid, **serve_kw):
+        return ServeSession(program=prog, params=params, config=pcfg,
+                            **serve_kw)
+
+    return make_replica
 
 
 def demo_decode_session(slots: int = 16, T: int = 16, Ts: int = 8,
@@ -433,6 +516,11 @@ def main(argv=None) -> int:
                          "pool (e.g. 0.5); enables the prefix cache")
     ap.add_argument("--prefix-pool", type=int, default=4,
                     help="size of the shared-prefix pool")
+    ap.add_argument("--mixed-regime", action="store_true",
+                    help="decode mode: the disaggregation traffic "
+                         "shape — a deterministic long-prefill/"
+                         "short-decode vs short-prefill/long-decode "
+                         "mix with per-request decode budgets")
     args = ap.parse_args(argv)
     if args.sweep:
         if args.prefix_share is not None:
@@ -444,6 +532,10 @@ def main(argv=None) -> int:
         rows = sweep_decode(levels=levels)
         print(json.dumps({"sweep": rows}, indent=2, default=str))
         return 0 if all(r["failed"] == 0 for r in rows) else 1
+    if args.mixed_regime and args.prefix_share is not None:
+        ap.error("--mixed-regime and --prefix-share are separate "
+                 "traffic shapes; pick one")
+    mnt = None
     if args.mode == "decode":
         sess, make_feed = demo_decode_session(
             prefix_cache=args.prefix_share is not None)
@@ -451,15 +543,22 @@ def main(argv=None) -> int:
             make_feed = shared_prefix_feed(
                 prefix_share=args.prefix_share,
                 pool_size=args.prefix_pool)
+        if args.mixed_regime:
+            make_feed, mnt = mixed_regime_feed()
     else:
         if args.prefix_share is not None:
             ap.error("--prefix-share needs --mode decode (the prefix "
                      "cache lives on the continuous-decode path)")
+        if args.mixed_regime:
+            ap.error("--mixed-regime needs --mode decode (decode "
+                     "budgets only exist on the continuous-decode "
+                     "path)")
         sess, make_feed = demo_session()
     try:
         report = run_load(sess, make_feed, args.requests,
                           concurrency=args.concurrency,
-                          deadline_ms=args.deadline_ms)
+                          deadline_ms=args.deadline_ms,
+                          max_new_tokens=mnt)
         report["serve_metrics"] = sess.stats()
     finally:
         sess.close()
