@@ -77,6 +77,11 @@ PROBE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #               analytic live-pages-only vs gather HBM table at the
 #               flagship decode shape) — a new block with gate-side
 #               skip semantics, no bump.
+#               r20+: a top-level "ops" block (ISSUE 20,
+#               tools/check_goodput.py: run-lifetime goodput fraction
+#               and badput breakdown from the chaos rig, plus the
+#               journal-emit / alert-eval unit costs) — a new block
+#               with gate-side skip semantics, no bump.
 BENCH_VERSION = 3
 BASELINE_BASIS = ("sampled-softmax vs full-softmax LM1B at the same "
                   "memory-limited batch; headline measured separately at "
@@ -869,6 +874,44 @@ def worker_main():
             print(f"# numerics bench failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", flush=True)
 
+    # Ops observatory block (ISSUE 20): the run-lifetime goodput
+    # fraction and badput breakdown from the chaos rig
+    # (tools/check_goodput.py: clean / SIGKILL-resume / NaN-rollback
+    # children, each account summing to wall by construction), plus
+    # the journal-emit and alert-eval unit costs priced standalone.
+    # tools/check_regression.py secondary-gates ops.goodput_fraction
+    # (a falling fraction means the instrumented loop is losing wall
+    # to badput) and ops.alert_eval_us (a full rule pass creeping up).
+    # Absolutes are CPU-relative. PARALLAX_BENCH_OPS=0 skips. No
+    # BENCH_VERSION bump: new block, gate-side skip.
+    ops_snap = None
+    if os.environ.get("PARALLAX_BENCH_OPS", "1") != "0":
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from parallax_tpu import obs
+            from tools import check_goodput
+            from tools.check_obs_overhead import _unit_cost_us
+            gres = check_goodput.measure()
+            gviol = check_goodput.check(gres)
+            jr = obs.EventJournal(capacity=64,
+                                  registry=obs.MetricsRegistry())
+            eng = obs.AlertEngine(obs.MetricsRegistry(),
+                                  rules=obs.builtin_rules(),
+                                  interval_s=3600.0)
+            ops_snap = dict(
+                gres["bench"],
+                goodput_fraction=gres["bench"]
+                ["clean_goodput_fraction"],
+                journal_emit_us=round(_unit_cost_us(
+                    lambda: jr.emit("bench", "tick", n=1)), 3),
+                alert_eval_us=round(_unit_cost_us(
+                    eng.evaluate, iters=200, batches=5), 3),
+                chaos_ok=not gviol,
+                violations=gviol[:3] or None)
+        except Exception as e:
+            print(f"# ops bench failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
     per_chip = hybrid_wps / n_chips
 
     # Same-round A/B on a bench_version bump (VERDICT r5 item 6): the
@@ -982,6 +1025,10 @@ def worker_main():
         # on the sampled rig, drift-sentinel clean/perturbed self-test
         # (CPU-relative interpret-mode agreement), host consume cost
         "numerics": numerics_snap,
+        # ops observatory (ISSUE 20): run-lifetime goodput fraction +
+        # badput breakdown from the chaos rig, journal-emit /
+        # alert-eval unit costs (CPU-relative)
+        "ops": ops_snap,
         # same-round A/B under the previous round's harness params,
         # recorded iff bench_version bumped this round (VERDICT r5
         # item 6); tools/check_regression.py requires it to treat a

@@ -48,11 +48,14 @@ from parallax_tpu.ckpt.store import CheckpointStore
 
 class CheckpointHook:
     def __init__(self, config: Optional[CheckPointConfig],
-                 worker_id: int, registry=None):
+                 worker_id: int, registry=None, journal=None):
         self._config = config or CheckPointConfig()
         self._worker_id = worker_id
         self._store: Optional[CheckpointStore] = None
         self._last_save_time = time.time()
+        # run-event journal (obs/journal.py): save/restore/save_now
+        # land in the causal record next to the incidents around them
+        self._journal = journal
         if registry is None:
             from parallax_tpu.obs.metrics import MetricsRegistry
             registry = MetricsRegistry()
@@ -66,6 +69,9 @@ class CheckpointHook:
         self._async_warned = False
         self.last_saved_step: Optional[int] = None
         self.last_restore_info: Optional[Dict[str, Any]] = None
+        # restore-verify wall of the LAST restore() — the goodput
+        # ledger books it as restore_replay badput
+        self.last_restore_seconds: Optional[float] = None
         if self._config.ckpt_dir:
             if (self._config.save_ckpt_steps is None
                     and self._config.save_ckpt_secs is None):
@@ -168,6 +174,10 @@ class CheckpointHook:
             parallax_log.warning(
                 "checkpoint save_now(%s) committed step %d", reason,
                 int(step))
+            if self._journal is not None:
+                self._journal.emit("ckpt", "save_now",
+                                   severity="warning", step=int(step),
+                                   reason=reason)
             return d
         except BaseException as e:
             parallax_log.error("checkpoint save_now(%s) failed: %s",
@@ -186,10 +196,15 @@ class CheckpointHook:
                     "a background thread next to training collectives)")
             use_async = False
         if not use_async:
+            t0 = time.perf_counter()
             self._store.save(step, state, extras=extras)
             self.last_saved_step = int(step)
             self._last_save_time = time.time()
             parallax_log.info("saved checkpoint at step %d", step)
+            if self._journal is not None:
+                self._journal.emit(
+                    "ckpt", "save", step=int(step), mode="sync",
+                    save_s=round(time.perf_counter() - t0, 4))
             return
         # async: bounded staleness — join (and surface) the previous
         # commit before dispatching a new one, so at most one save is
@@ -214,6 +229,9 @@ class CheckpointHook:
         # must not claim durability the disk doesn't have yet
         parallax_log.info("dispatched checkpoint save at step %d "
                           "(async commit)", step)
+        if self._journal is not None:
+            self._journal.emit("ckpt", "save", step=int(step),
+                               mode="async_dispatch")
 
     def _join_writer(self, count: bool) -> None:
         w = self._writer
@@ -244,7 +262,8 @@ class CheckpointHook:
             return None
         state, step, info = out
         self.last_restore_info = info
-        self._restore_s.record(time.perf_counter() - t0)
+        self.last_restore_seconds = time.perf_counter() - t0
+        self._restore_s.record(self.last_restore_seconds)
         return state
 
     @property

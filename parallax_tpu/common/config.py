@@ -704,6 +704,31 @@ class ParallaxConfig:
     # See the AnomalyConfig docstring.
     anomaly_config: "AnomalyConfig" = dataclasses.field(
         default_factory=lambda: AnomalyConfig())
+    # -- ops observatory (obs/journal, obs/goodput, obs/alerts) ----------
+    # JSONL file the event journal appends one line per lifecycle
+    # event to (anomalies, rollbacks, ckpt save/restore, preemption,
+    # fleet churn, tuner decisions, alert firings). None (default) =
+    # in-memory ring only; the ring tail still rides in flight dumps.
+    journal_path: Optional[str] = None
+    # Ring capacity (events) of the in-memory journal — the recent
+    # causal history flight dumps embed. ~200 bytes/event.
+    journal_capacity: int = 512
+    # Size bound for the journal JSONL file: rotates to `<path>.1`
+    # (like metrics_max_bytes). None = unbounded growth.
+    journal_max_bytes: Optional[int] = None
+    # Alert-evaluation cadence (seconds): the session polls the alert
+    # engine from the step loop (one clock compare per step; a full
+    # rule pass only every alert_interval_s). The engine itself exists
+    # whenever the obs layer is enabled — disabling obs removes it
+    # structurally (no rules, no state, no thread).
+    alert_interval_s: float = 30.0
+    # Extra AlertRules armed next to the builtins (SLO burn,
+    # instability, serve recompiles, page-pool exhaustion,
+    # goodput-below-floor). See obs/alerts.py.
+    alert_rules: Sequence[Any] = ()
+    # Threshold for the goodput-below-floor builtin rule; the rule is
+    # guarded on >= 120s of run wall so short runs never fire it.
+    goodput_floor: float = 0.5
     # sync=False only: gradient staleness bound k — each step applies
     # the gradients computed k steps earlier (deterministic SPMD
     # emulation of the reference's async PS, whose staleness was
@@ -808,6 +833,23 @@ class ParallaxConfig:
         if int(self.flight_steps) < 1:
             raise ValueError(
                 f"flight_steps must be >= 1, got {self.flight_steps}")
+        if int(self.journal_capacity) < 1:
+            raise ValueError(
+                f"journal_capacity must be >= 1, got "
+                f"{self.journal_capacity}")
+        if self.journal_max_bytes is not None \
+                and int(self.journal_max_bytes) <= 0:
+            raise ValueError(
+                f"journal_max_bytes must be > 0 or None, got "
+                f"{self.journal_max_bytes}")
+        if float(self.alert_interval_s) <= 0:
+            raise ValueError(
+                f"alert_interval_s must be > 0, got "
+                f"{self.alert_interval_s}")
+        if not (0.0 <= float(self.goodput_floor) <= 1.0):
+            raise ValueError(
+                f"goodput_floor must be in [0, 1], got "
+                f"{self.goodput_floor}")
         if self.shape_buckets is not None:
             # one validation rule, owned by compile/bucketing.py (the
             # lazy import keeps config importable before the package

@@ -31,6 +31,11 @@ PARALLAX_COORDINATOR_PORT_DEFAULT = 8476
 # last checkpoint, at most this many times.
 PARALLAX_MAX_RESTARTS = "PARALLAX_MAX_RESTARTS"
 PARALLAX_RESTART_ATTEMPT = "PARALLAX_RESTART_ATTEMPT"  # set on workers
+# Spawn-time wall clock (time.time()) injected into each worker so the
+# goodput ledger (obs/goodput.py) anchors the run at process SPAWN and
+# startup/import time is accounted as compile_warmup badput instead of
+# leaking out of the sum-to-wall invariant.
+PARALLAX_RUN_EPOCH = "PARALLAX_RUN_EPOCH"
 
 # --- partition auto-search (reference consts.py + partitions.py:29-31) -----
 # Search state lives in the session (in-place re-jit), so the reference's

@@ -31,7 +31,8 @@ from parallax_tpu.common.lib import (HostInfo, _shell_quote, is_local_host,
 def launch_workers(hosts: Sequence[HostInfo],
                    redirect_path: str | None = None,
                    max_restarts: int | None = None,
-                   has_checkpoint: bool = False) -> int:
+                   has_checkpoint: bool = False,
+                   journal=None) -> int:
     """Spawn the current script on every host; wait on the chief; SIGINT
     the rest on exit (reference runner.py:124-136 cleanup semantics).
 
@@ -50,12 +51,22 @@ def launch_workers(hosts: Sequence[HostInfo],
     separate redirect logs so the crashed attempt's diagnostics
     survive.
 
+    ``journal`` (an :class:`~parallax_tpu.obs.journal.EventJournal`)
+    records the master-side lifecycle — launch, worker death, elastic
+    restart, surrender — in the same causal stream the workers'
+    sessions write their own events to. Each spawn also injects
+    ``PARALLAX_RUN_EPOCH`` so every worker's goodput ledger anchors at
+    spawn rather than at session construction.
+
     Returns the final attempt's exit code.
     """
     if max_restarts is None:
         max_restarts = int(os.environ.get(consts.PARALLAX_MAX_RESTARTS,
                                           "0"))
     attempt = 0
+    if journal is not None:
+        journal.emit("launcher", "launch", hosts=len(hosts),
+                     max_restarts=max_restarts)
     while True:
         rc, user_interrupt = _run_cluster_once(hosts, redirect_path,
                                                attempt)
@@ -63,12 +74,19 @@ def launch_workers(hosts: Sequence[HostInfo],
         # worker exiting 130 (SIGINT from infra, or our own abort
         # propagation) is a genuine failure and must retry.
         if rc == 0 or user_interrupt:
+            if journal is not None:
+                journal.emit("launcher", "exit", rc=rc,
+                             attempt=attempt,
+                             user_interrupt=user_interrupt)
             return rc
         if attempt >= max_restarts:
             if max_restarts:
                 parallax_log.error(
                     "cluster failed (rc=%d) after %d restart(s); "
                     "giving up", rc, attempt)
+            if journal is not None:
+                journal.emit("launcher", "surrender", severity="error",
+                             rc=rc, attempts=attempt + 1)
             return rc
         attempt += 1
         parallax_log.warning(
@@ -79,6 +97,11 @@ def launch_workers(hosts: Sequence[HostInfo],
             "NO ckpt_dir is configured, so training restarts from "
             "step 0 (set CheckPointConfig.ckpt_dir to make restarts "
             "resume)")
+        if journal is not None:
+            journal.emit("launcher", "elastic_restart",
+                         severity="warning", rc=rc, attempt=attempt,
+                         max_restarts=max_restarts,
+                         resumes_from_checkpoint=has_checkpoint)
 
 
 def _remote_kill(hostname: str, pidfile: str) -> None:
@@ -139,6 +162,10 @@ def _run_cluster_once(hosts: Sequence[HostInfo],
             consts.PARALLAX_RESOURCE_INFO: serialized,
             consts.PARALLAX_COORDINATOR_ADDRESS: coordinator,
             consts.PARALLAX_RESTART_ATTEMPT: attempt,
+            # anchor each worker's goodput ledger at SPAWN: startup
+            # (ssh, imports, device init) books as compile_warmup
+            # badput instead of escaping the run account
+            consts.PARALLAX_RUN_EPOCH: f"{_time.time():.6f}",
         }
         for var in (consts.PARALLAX_MIN_PARTITIONS,
                     consts.PARALLAX_PARTITIONS, consts.PARALLAX_LOG_LEVEL):

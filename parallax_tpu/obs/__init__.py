@@ -67,6 +67,26 @@ The numerics observatory (ISSUE 17) watches the training math itself:
     shadow-evaling each Pallas executor against its reference, and
     the anomaly-fed ``health.instability`` score.
 
+The ops observatory (ISSUE 20) accounts for the run's LIFETIME:
+
+  * :mod:`~parallax_tpu.obs.journal` — one append-only,
+    causally-ordered event stream every lifecycle emitter routes
+    through (anomalies, rollbacks, ckpt save/restore, preemption,
+    fleet churn, tuner decisions, alerts), with a bounded ring whose
+    tail rides in every flight dump and an optional rotating JSONL
+    sink (``Config(journal_path=...)``).
+  * :mod:`~parallax_tpu.obs.goodput` — run-lifetime goodput/badput
+    ledger: productive step time vs named badput classes summing to
+    wall clock by construction, persisted through checkpoint manifest
+    extras so a resumed run accounts across attempts; also the single
+    owner of the per-step goodput math ``StepTimeline.goodput()``
+    delegates to.
+  * :mod:`~parallax_tpu.obs.alerts` — declarative threshold /
+    burn-rate / absence rules over registry snapshots with a
+    pending→firing→resolved lifecycle, dedup/cooldown, and firings
+    emitted to the journal, a flight dump and the exporter's
+    ``parallax_alerts`` section.
+
 ``disable()`` / ``enable()`` (or env ``PARALLAX_OBS=0``) switch the
 whole layer to near-free no-ops process-wide;
 `tools/check_obs_overhead.py` holds the enabled path to <=2% of step
@@ -74,9 +94,15 @@ wall-time.
 """
 
 from parallax_tpu.obs._state import disable, enable, is_enabled
-from parallax_tpu.obs import (aggregate, anomaly, export, flightrec,
-                              health, memwatch, metrics, numwatch,
-                              reqtrace, timeline, trace, xprof)
+from parallax_tpu.obs import (aggregate, alerts, anomaly, export,
+                              flightrec, goodput, health, journal,
+                              memwatch, metrics, numwatch, reqtrace,
+                              timeline, trace, xprof)
+from parallax_tpu.obs.alerts import (AlertEngine, AlertRule,
+                                     builtin_rules)
+from parallax_tpu.obs.goodput import (GoodputLedger, BADPUT_CLASSES,
+                                      dominant_badput, step_goodput)
+from parallax_tpu.obs.journal import EventJournal, read_journal
 from parallax_tpu.obs.memwatch import MemWatch
 from parallax_tpu.obs.aggregate import (aggregate_host_step_times,
                                         find_stragglers)
@@ -97,6 +123,9 @@ from parallax_tpu.obs.trace import (TraceCollector, TraceEvent,
 __all__ = [
     "trace", "metrics", "health", "timeline", "flightrec", "anomaly",
     "aggregate", "reqtrace", "export", "xprof", "memwatch", "numwatch",
+    "journal", "goodput", "alerts", "EventJournal", "read_journal",
+    "GoodputLedger", "BADPUT_CLASSES", "dominant_badput",
+    "step_goodput", "AlertEngine", "AlertRule", "builtin_rules",
     "NumericsMonitor", "DriftSentinel", "provenance_report",
     "MemWatch", "span", "TraceCollector",
     "TraceEvent", "export_chrome_trace", "MetricsRegistry", "Counter",

@@ -64,10 +64,17 @@ def _labels(source: str, extra: str = "") -> str:
 
 
 def render_prometheus(snapshots: Dict[str, Dict],
-                      prefix: str = "parallax") -> str:
+                      prefix: str = "parallax",
+                      alerts: Optional[list] = None) -> str:
     """``{source: registry_snapshot}`` -> Prometheus text exposition.
     Deterministic ordering (sorted metric, then source) so scrapes
-    diff cleanly."""
+    diff cleanly.
+
+    ``alerts`` (ISSUE 20): rows from
+    ``AlertEngine.prometheus_alerts()`` render as a dedicated
+    ``<prefix>_alerts`` section — one sample per rule,
+    ``{alert=,severity=,state=}`` labeled, value 1 while firing — so
+    a monitoring stack needs no recording rules to see firings."""
     # metric name -> [(labels, value)]
     samples: Dict[str, list] = {}
 
@@ -93,6 +100,13 @@ def render_prometheus(snapshots: Dict[str, Dict],
             else:
                 put(base, _labels(source), value)
 
+    for row in alerts or ():
+        put(f"{prefix}_alerts",
+            _labels("", f'alert="{row.get("alert", "")}",'
+                        f'severity="{row.get("severity", "")}",'
+                        f'state="{row.get("state", "")}"'),
+            row.get("value"))
+
     lines = []
     for name in sorted(samples):
         lines.append(f"# TYPE {name} gauge")
@@ -107,8 +121,12 @@ class TelemetryExporter:
 
     def __init__(self, snapshot_fn: Callable[[], Dict[str, Dict]],
                  port: int = 0, host: str = "127.0.0.1",
-                 prefix: str = "parallax"):
+                 prefix: str = "parallax",
+                 alerts_fn: Optional[Callable[[], list]] = None):
         self._snapshot_fn = snapshot_fn
+        # zero-arg provider of AlertEngine.prometheus_alerts() rows;
+        # sampled lazily per GET like the snapshot itself
+        self._alerts_fn = alerts_fn
         self._host = host
         self._requested_port = int(port)
         self._prefix = prefix
@@ -154,8 +172,11 @@ class TelemetryExporter:
                 try:
                     # snapshot per GET: lazy gauges (serve.timeline.*)
                     # are priced at scrape time, never in steady state
+                    alerts = (exporter._alerts_fn()
+                              if exporter._alerts_fn else None)
                     text = render_prometheus(exporter._snapshot_fn(),
-                                             exporter._prefix)
+                                             exporter._prefix,
+                                             alerts=alerts)
                 except Exception as e:  # a scrape must never crash
                     self._send(500, f"# snapshot failed: "
                                     f"{type(e).__name__}: {e}\n"

@@ -55,8 +55,13 @@ class FlightRecorder:
     def __init__(self, flight_dir: Optional[str] = None,
                  providers: Optional[Dict[str, Callable[[], Any]]] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 max_dumps: int = 8):
+                 max_dumps: int = 8, journal=None):
         self.flight_dir = flight_dir
+        # journal-backed incident correlation (ISSUE 20): every dump
+        # — and every SUPPRESSED trigger — lands in the event journal,
+        # so the causal record carries the incident_id the artifact
+        # does, and rate-limited incidents stay visible
+        self.journal = journal
         self._providers: Dict[str, Callable[[], Any]] = dict(
             providers or {})
         self._registry = registry if registry is not None \
@@ -97,6 +102,10 @@ class FlightRecorder:
                 # not vanish — flightrec.suppressed.<class> names it
                 self._registry.counter(
                     "flightrec.suppressed." + key).inc()
+                if self.journal is not None:
+                    self.journal.emit(
+                        "flight", "dump_suppressed",
+                        severity="warning", reason=reason, klass=key)
                 return None
             # claimed BEFORE dumping so a concurrent trigger of the
             # same class cannot double-dump...
@@ -151,6 +160,14 @@ class FlightRecorder:
         self._dumps.inc()
         with self._lock:
             self.dump_paths.append(path)
+        if self.journal is not None:
+            # emitted AFTER the artifact is written: the dump's own
+            # journal_tail section shows the history that LED here,
+            # and this event (carrying the same incident_id) lets any
+            # later consumer join journal <-> artifact
+            self.journal.emit("flight", "dump", severity="warning",
+                              incident_id=incident_id, reason=reason,
+                              path=path)
         parallax_log.warning("flight recorder dumped %r to %s", reason,
                              path)
         return path

@@ -223,27 +223,10 @@ class StepTimeline:
     def goodput(self) -> Dict:
         """The goodput account over the rolling window: per-phase
         mean milliseconds and fraction of mean wall time, plus MFU.
-        JSON-ready (bench.py, flight dumps)."""
-        rows = self.rows()
-        if not rows:
-            return {"steps": 0}
-        n = len(rows)
-        wall_mean = sum(r["wall_ms"] for r in rows) / n
-        phases = {}
-        fractions = {}
-        for comp in COMPONENTS + ("device_est_ms",):
-            mean = sum(r[comp] for r in rows) / n
-            phases[comp] = round(mean, 4)
-            fractions[comp] = (round(mean / wall_mean, 4)
-                               if wall_mean > 0 else None)
-        mfus = [r["mfu"] for r in rows if r["mfu"] is not None]
-        return {
-            "steps": n,
-            "wall_ms_mean": round(wall_mean, 4),
-            "phase_ms_mean": phases,
-            "phase_frac": fractions,
-            "mfu_mean": (round(sum(mfus) / len(mfus), 4)
-                         if mfus else None),
-            "flops_per_step": self._flops_per_step,
-            "peak_flops_total": self._peak_flops_total,
-        }
+        JSON-ready (bench.py, flight dumps). Thin delegate: the math
+        lives in obs/goodput.py (:func:`~parallax_tpu.obs.goodput.
+        step_goodput`), the single owner of goodput arithmetic, so the
+        per-step window and the run-lifetime ledger can never
+        disagree; the keys here keep their historical meaning."""
+        from parallax_tpu.obs.goodput import step_goodput
+        return step_goodput(self)

@@ -229,13 +229,18 @@ class ServeFleet:
     def __init__(self, make_replica: Callable, *,
                  config: Optional[FleetConfig] = None,
                  metrics: Optional[obs_metrics.MetricsRegistry] = None,
-                 flight=None, anomaly=None, faults=None):
+                 flight=None, anomaly=None, faults=None,
+                 journal=None):
         self._cfg = config or FleetConfig()
         self._make_replica = make_replica
         self.metrics = metrics if metrics is not None \
             else obs_metrics.MetricsRegistry()
         self._flight = flight
         self._anomaly = anomaly
+        # run-event journal (obs/journal.py): fleet churn — replica
+        # deaths, ejections, hot-swaps, scale events — lands in the
+        # same causal record as the training/serving incidents
+        self._journal = journal
         self.faults = faults
         self._router = Router(self._cfg.health,
                               on_state_change=self._on_state_change)
@@ -375,6 +380,11 @@ class ServeFleet:
         parallax_log.error("fleet: replica %r died: %s", rid, exc)
         self._router.eject(rid, reason=f"fatal: {exc}", permanent=True)
         self._update_gauges()
+        if self._journal is not None:
+            self._journal.emit(
+                "fleet", "replica_fatal", severity="error",
+                replica=str(rid),
+                error=f"{type(exc).__name__}: {exc}")
         if self._flight is not None:
             # by this point the dead replica's requests have already
             # been failed over (the scheduler's failure cascade runs
@@ -415,6 +425,11 @@ class ServeFleet:
         self._update_gauges()
         if new == EJECTED:
             self._ejections.inc()
+            if self._journal is not None:
+                self._journal.emit(
+                    "fleet", "ejection", severity="warning",
+                    replica=str(handle.rid), from_state=old,
+                    reason=reason)
             if self._flight is not None:
                 self._flight.trigger(
                     f"fleet_ejection:replica_{handle.rid}",
@@ -771,6 +786,14 @@ class ServeFleet:
                     "(drained in %.3fs)", h.rid,
                     time.perf_counter() - t0)
         self._update_gauges()
+        if self._journal is not None:
+            self._journal.emit(
+                "fleet", "hotswap",
+                severity="error" if failures else "info",
+                swapped=sum(1 for v in outcome.values()
+                            if v == "swapped"),
+                failed=len(failures),
+                variant=variant)
         if failures:
             raise RuntimeError(
                 f"hot-swap failed on {len(failures)} replica(s): "
@@ -886,6 +909,10 @@ class ServeFleet:
         self._update_gauges()
         parallax_log.info("fleet: scaled UP to %d replicas (%s)",
                           self.num_replicas, reason)
+        if self._journal is not None:
+            self._journal.emit("fleet", "scale_up",
+                               replicas=self.num_replicas,
+                               reason=reason)
         if self._anomaly is not None:
             self._anomaly.notify_deliberate_change(
                 f"fleet scale-up ({reason})")
@@ -934,6 +961,10 @@ class ServeFleet:
         self._update_gauges()
         parallax_log.info("fleet: scaled DOWN to %d replicas (%s)",
                           self.num_replicas, reason)
+        if self._journal is not None:
+            self._journal.emit("fleet", "scale_down",
+                               replicas=self.num_replicas,
+                               reason=reason)
         if self._anomaly is not None:
             self._anomaly.notify_deliberate_change(
                 f"fleet scale-down ({reason})")
@@ -1015,11 +1046,14 @@ class ServeFleet:
 
     # -- introspection / teardown ------------------------------------------
 
-    def start_exporter(self, port: int = 0):
+    def start_exporter(self, port: int = 0, alerts_fn=None):
         """Serve the fleet's live telemetry (fleet aggregates PLUS
         every replica's ``serve.*`` registry, ``source``-labeled) as
-        Prometheus text on a localhost port (0 = OS-assigned). Returns
-        the running :class:`~parallax_tpu.obs.export.TelemetryExporter`
+        Prometheus text on a localhost port (0 = OS-assigned).
+        ``alerts_fn`` (e.g. an ``AlertEngine.prometheus_alerts`` bound
+        method) adds a ``parallax_alerts`` section to the scrape.
+        Returns the running
+        :class:`~parallax_tpu.obs.export.TelemetryExporter`
         (``.url`` has the endpoint); stopped automatically at
         :meth:`close`."""
         from parallax_tpu.obs.export import TelemetryExporter
@@ -1034,7 +1068,8 @@ class ServeFleet:
                 out[f"replica{rid}"] = reg.snapshot()
             return out
 
-        self._exporter = TelemetryExporter(snapshot, port=port)
+        self._exporter = TelemetryExporter(snapshot, port=port,
+                                           alerts_fn=alerts_fn)
         return self._exporter.start()
 
     def recompiles(self) -> int:

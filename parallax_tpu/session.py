@@ -57,8 +57,11 @@ from parallax_tpu.ckpt import CheckpointHook, RecoveryPolicy, \
 from parallax_tpu.obs import aggregate as aggregate_lib, \
     memwatch as memwatch_lib, numwatch as numwatch_lib, trace, xprof
 from parallax_tpu.obs._state import is_enabled as obs_enabled
+from parallax_tpu.obs.alerts import AlertEngine, builtin_rules
 from parallax_tpu.obs.anomaly import AnomalyMonitor
 from parallax_tpu.obs.flightrec import FlightRecorder
+from parallax_tpu.obs.goodput import GoodputLedger
+from parallax_tpu.obs.journal import EventJournal
 from parallax_tpu.obs.health import HealthMonitor, device_memory_stats
 from parallax_tpu.obs.metrics import (JsonlSink, MetricsRegistry,
                                       PipelineStats)
@@ -323,11 +326,29 @@ class ParallaxSession:
                                       on_event=self._on_anomaly)
         self._last_host_report: Optional[Dict] = None
         self._flops_resolved = False
+        # -- ops observatory (obs/journal, goodput, alerts, ISSUE 20) --
+        # Structural killswitch (the numerics pattern): with
+        # PARALLAX_OBS=0 none of the three are constructed — no event
+        # ring, no ledger gauges/accounting, no alert rules or state
+        # (check_obs_overhead asserts the absence structurally).
+        import os as _os_mod
+        _run_epoch = _os_mod.environ.get("PARALLAX_RUN_EPOCH")
+        self.journal = (EventJournal(
+            capacity=config.journal_capacity,
+            path=config.journal_path,
+            max_bytes=config.journal_max_bytes,
+            registry=self.metrics)
+            if obs_enabled() else None)
+        self.ledger = (GoodputLedger(
+            self.metrics, journal=self.journal,
+            run_epoch=(float(_run_epoch) if _run_epoch else None))
+            if obs_enabled() else None)
         # -- checkpoint/recovery subsystem (ckpt/) ----------------------
         # the hook shares the session registry so ckpt.* metrics land
         # in the same snapshot as pipeline.*/engine.*
         self._ckpt = CheckpointHook(config.ckpt_config, worker_id,
-                                    registry=self.metrics)
+                                    registry=self.metrics,
+                                    journal=self.journal)
         self._recovery = (RecoveryPolicy(
             config.recovery_config, self.metrics,
             on_rollback=self._fire_rollback_hooks)
@@ -338,6 +359,7 @@ class ParallaxSession:
         self._session_closed = False
         self.flight = FlightRecorder(
             flight_dir=config.flight_dir, registry=self.metrics,
+            journal=self.journal,
             providers={
                 "progress": lambda: {"host_step": self._host_step},
                 "steps": self.timeline.rows,
@@ -363,6 +385,26 @@ class ParallaxSession:
             self.metrics, flight=self.flight,
             capacity=config.flight_steps)
         self.flight.add_provider("memwatch", self.memwatch.stats)
+        if self.journal is not None:
+            # every incident artifact embeds its own causal history
+            self.flight.add_provider(
+                "journal_tail", lambda: self.journal.tail(64))
+        if self.ledger is not None:
+            self.flight.add_provider(
+                "ops", lambda: self.ledger.account(self.timeline))
+        # declarative alerting over the same registry: builtins (SLO
+        # burn, instability, serve recompiles, page-pool exhaustion,
+        # goodput floor) + user rules; polled from the step loop on
+        # config.alert_interval_s and drained once more at close
+        self.alerts = (AlertEngine(
+            self.metrics,
+            rules=(builtin_rules(config.goodput_floor)
+                   + tuple(config.alert_rules)),
+            journal=self.journal, flight=self.flight,
+            interval_s=config.alert_interval_s)
+            if obs_enabled() else None)
+        if self.alerts is not None:
+            self.flight.add_provider("alerts", self.alerts.summary)
         self._register_profile_gauges()
         self.health = (HealthMonitor(
             self.metrics, on_nonfinite=self._on_nonfinite,
@@ -449,13 +491,29 @@ class ParallaxSession:
         self.anomaly.restore_snapshot(extras.get("anomaly"))
         if self.health is not None:
             self.health.restore_snapshot(extras.get("health"))
+        if self.ledger is not None:
+            # adopt the previous attempt's cumulative account; the
+            # verify-restore wall books as restore_replay and the
+            # kill-to-respawn gap as eviction_downtime
+            self.ledger.restore_snapshot(
+                extras.get("ops"),
+                restore_s=self._ckpt.last_restore_seconds or 0.0)
         parallax_log.info(
             "restored checkpoint at step %d (data cursor %d)",
             self._host_step, self._data_cursor)
         if info.get("fallbacks") or info.get("torn_steps"):
             # a torn/corrupt newest checkpoint was skipped: loud in the
             # log (store.py) AND a post-mortem artifact for the fleet
+            if self.journal is not None:
+                self.journal.emit("ckpt", "torn_fallback",
+                                  severity="warning", **dict(info))
             self.flight.trigger("ckpt_torn", dict(info))
+        if self.journal is not None:
+            self.journal.emit(
+                "ckpt", "restored", severity="info",
+                step=self._host_step, data_cursor=self._data_cursor,
+                restore_s=round(
+                    self._ckpt.last_restore_seconds or 0.0, 4))
         self.flight.trigger(
             "resume", {"step": self._host_step,
                        "data_cursor": self._data_cursor,
@@ -875,11 +933,15 @@ class ParallaxSession:
         # goodput fractions).
         wall_s = (gap if gap is not None
                   else data_wait_s + convert_s) + dt
-        self.timeline.record_step(
+        row = self.timeline.record_step(
             step, t0, wall_s, data_wait_s=data_wait_s,
             convert_s=convert_s, h2d_s=self._engine.pop_h2d_seconds(),
             dispatch_s=dt, fetch_block_s=blocked_s,
             h2d_pre_s=h2d_pre_s)
+        if self.ledger is not None:
+            # run-lifetime account: this step's wall becomes
+            # productive time minus its data-wait lane (obs/goodput)
+            self.ledger.on_step(row)
         self.anomaly.observe("step_time_ms", step, wall_s * 1e3)
         # live-HBM sample post-dispatch (no-op on backends without
         # memory_stats, structural no-op under the obs killswitch)
@@ -912,9 +974,21 @@ class ParallaxSession:
             self.health.observe(step, outputs.get("loss_finite"),
                                 outputs.get("grad_norm"),
                                 loss=outputs.get("loss"))
+        t_ck = time.perf_counter()
         if self._ckpt.maybe_save(self._host_step, self._state,
                                  extras_fn=self._ckpt_extras):
             self._warn_sparse_overflow("checkpoint")
+            if self.ledger is not None:
+                # the save's host wall lands inside the next step's
+                # dispatch gap too, so the ledger carves it back out
+                # of productive rather than double-counting
+                self.ledger.note_badput(
+                    "ckpt_stall", time.perf_counter() - t_ck,
+                    carve_from_productive=True)
+        if self.alerts is not None:
+            # cheap clock compare; a full rule pass only every
+            # config.alert_interval_s
+            self.alerts.poll()
         if self._search is not None:
             self._record_search_time(dt)
         return self._convert_fetch(fetches, outputs, lazy=not blocking,
@@ -1209,6 +1283,14 @@ class ParallaxSession:
             self.health.record_instability_event(
                 0.5 if event.signal.startswith(("numerics.", "loss",
                                                 "grad_norm")) else 0.25)
+        if self.journal is not None:
+            # journaled BEFORE the flight trigger so the dump's own
+            # journal_tail section already shows this event
+            self.journal.emit(
+                "anomaly", event.kind, severity="warning",
+                signal=event.signal, step=event.step,
+                value=event.value, baseline=event.baseline,
+                ratio=event.ratio)
         self.flight.trigger(
             f"anomaly_{event.signal}_{event.kind}",
             {"signal": event.signal, "kind": event.kind,
@@ -1269,6 +1351,12 @@ class ParallaxSession:
                     "%.3e (tol %.1e), argmax flips %s", r["name"],
                     r["rel_err"], r["rel_err_tol"],
                     r["argmax_flip_frac"])
+                if self.journal is not None:
+                    self.journal.emit(
+                        "numerics", "kernel_drift",
+                        severity="warning", name=r["name"],
+                        rel_err=r["rel_err"],
+                        argmax_flip_frac=r["argmax_flip_frac"])
                 self.flight.trigger(
                     f"kernel_drift_{r['name']}", dict(r))
         return results
@@ -1311,6 +1399,10 @@ class ParallaxSession:
                        if self.health is not None else None),
             "recovery": (self._recovery.stats()
                          if self._recovery is not None else None),
+            # cumulative goodput/badput totals: a resumed run reports
+            # the account ACROSS attempts (obs/goodput.py)
+            "ops": (self.ledger.snapshot()
+                    if self.ledger is not None else None),
         }
 
     def set_rollback_hook(self, fn) -> None:
@@ -1363,10 +1455,21 @@ class ParallaxSession:
                 detail["stats_trail"] = self.numerics.trail_tail(16)
             except Exception as e:
                 detail["provenance_error"] = f"{type(e).__name__}: {e}"
+        if self.journal is not None:
+            self.journal.emit(
+                "recovery", "nonfinite_rollback", severity="error",
+                step=step, kind=kind,
+                snapshot_step=self._recovery.snapshot_step,
+                data_cursor=self._data_cursor)
         self.flight.trigger("nonfinite_rollback", detail)
         try:
             state, snap_step = self._recovery.rollback(step, kind)
         except RecoverySurrender as e:
+            if self.journal is not None:
+                self.journal.emit(
+                    "recovery", "surrender", severity="error",
+                    step=step, kind=kind,
+                    rollbacks=self._recovery.total_rollbacks)
             self.flight.trigger(
                 "recovery_surrender",
                 {"step": step, "kind": kind, "error": str(e),
@@ -1374,6 +1477,15 @@ class ParallaxSession:
             raise
         self._state = state
         self._host_step = snap_step
+        if self.ledger is not None:
+            # the rewound steps trained nothing: their measured step
+            # time moves into the rollback_discarded badput class
+            discarded_s = self.ledger.on_rollback(snap_step)
+            if self.journal is not None:
+                self.journal.emit(
+                    "ops", "rollback_discarded", severity="warning",
+                    to_step=snap_step,
+                    discarded_s=round(discarded_s, 4))
         return True
 
     def on_preemption(self, signum: Optional[int] = None) -> None:
@@ -1388,6 +1500,11 @@ class ParallaxSession:
             # dumping/saving stale state
             return
         try:
+            if self.journal is not None:
+                self.journal.emit(
+                    "preempt", "sigterm", severity="warning",
+                    signal=signum, step=self._host_step,
+                    data_cursor=self._data_cursor)
             self.flight.trigger(
                 "preemption",
                 {"signal": signum, "step": self._host_step,
@@ -1581,6 +1698,16 @@ class ParallaxSession:
                                 {"summary": line, "report": report})
         return report
 
+    def ops_account(self) -> Optional[Dict[str, Any]]:
+        """The run-lifetime goodput/badput account (obs/goodput.py):
+        productive step time vs named badput classes, summing to wall
+        clock by construction, cumulative across restart attempts.
+        Embeds the per-step window partition. None when the obs layer
+        is disabled (the ledger is structurally absent)."""
+        if self.ledger is None:
+            return None
+        return self.ledger.account(self.timeline)
+
     # -- compile-ahead engine (compile/) ----------------------------------
 
     def warmup(self, feed_dict: Optional[Dict[str, Any]] = None,
@@ -1606,8 +1733,15 @@ class ParallaxSession:
                 "warmup needs an engine: pass feed_dict (or call "
                 "prepare(example_feed)) first")
         if not background:
+            t0w = time.perf_counter()
             with trace.span("session.warmup"):
                 stats = self._engine.warmup(self._state, batch_sizes)
+            if self.ledger is not None:
+                # blocking AOT compiles are the canonical
+                # compile/warmup badput (background warmup overlaps
+                # data startup and stays off the critical path)
+                self.ledger.note_badput("compile_warmup",
+                                        time.perf_counter() - t0w)
             # the AOT executable makes cost-analysis FLOPs free: attach
             # them (and the chip peak) so per-step MFU starts flowing;
             # same for the compiled-memory account (obs/memwatch.py)
@@ -1745,6 +1879,21 @@ class ParallaxSession:
                 parallax_log.info(
                     "mesh search done: winner %s (%s)",
                     best.describe(), self._tune_result.get("winner"))
+                if self.journal is not None:
+                    s = self._tune_result
+                    self.journal.emit(
+                        "tune", "decision",
+                        winner=s.get("winner"),
+                        trials_measured=s.get("trials_measured"),
+                        pruned_oom=s.get("pruned_oom"),
+                        cost_basis=s.get("cost_basis"))
+                    for refusal in (s.get("oom_refusals") or ()):
+                        self.journal.emit(
+                            "tune", "oom_refusal", severity="warning",
+                            **({"plan": str(refusal)}
+                               if not isinstance(refusal, dict)
+                               else {k: refusal[k]
+                                     for k in list(refusal)[:6]}))
                 self.flight.trigger("tune_decision", self._tune_result)
                 settled = (best.cache_key()
                            == self._plan.cache_key())
@@ -1870,6 +2019,23 @@ class ParallaxSession:
             self._warn_sparse_overflow("close")
         except Exception as e:  # reads live opt_state: can race donation
             parallax_log.warning("sparse-overflow check failed: %s", e)
+        if self.alerts is not None:
+            try:
+                # one final rule pass so a breach in the last
+                # alert_interval_s still fires, then stop any daemon
+                self.alerts.evaluate()
+                self.alerts.stop()
+            except Exception as e:
+                parallax_log.warning("alert engine stop failed: %s", e)
+        if self.journal is not None:
+            try:
+                self.journal.emit(
+                    "session", "close", step=self._host_step,
+                    goodput=(self.ledger.goodput_fraction()
+                             if self.ledger is not None else None))
+            except Exception as e:
+                parallax_log.warning("journal close event failed: %s",
+                                     e)
         try:
             self._ckpt.close()
         except Exception as e:  # e.g. a pending async save that failed

@@ -647,6 +647,14 @@ def test_obs_overhead_within_budget():
     assert last["numerics_samples_per_step"] == pytest.approx(0.25), last
     assert last["unit_costs_us"]["numerics_consume"] > 0, last
     assert last["numerics_killswitch_clean"], last
+    # the ops observatory (ISSUE 20) rides inside the same budget:
+    # journal emit, ledger fold and alert poll/eval are priced per
+    # unit, and the killswitch removes journal/ledger/alerts
+    # STRUCTURALLY (no objects on the session at all)
+    assert last["unit_costs_us"]["journal_emit"] > 0, last
+    assert last["unit_costs_us"]["ledger_on_step"] > 0, last
+    assert last["unit_costs_us"]["alert_eval"] > 0, last
+    assert last["ops_killswitch_clean"], last
 
 
 def test_serve_obs_overhead_within_budget():

@@ -1,11 +1,14 @@
 """Micro-bench: the observability layer must cost <=2% of step wall-time.
 
-ISSUE 2 acceptance (extended by ISSUEs 5, 13 and 17): the always-on
-instrumentation — spans + metrics registry, the per-step timeline
-attribution row, the step-time anomaly detector, the plan
+ISSUE 2 acceptance (extended by ISSUEs 5, 13, 17 and 20): the
+always-on instrumentation — spans + metrics registry, the per-step
+timeline attribution row, the step-time anomaly detector, the plan
 observatory's per-step memwatch sample and idle profile-hook bracket,
-and the numerics observatory at its default sampling duty cycle (one
-consume per sampled step + one skip per off-step)
+the numerics observatory at its default sampling duty cycle (one
+consume per sampled step + one skip per off-step), and the ops
+observatory's per-step terms (one goodput-ledger fold, one throttled
+alert poll, the amortized interval rule pass, journal emits at their
+measured event rate)
 — on the simple-model step loop stays within 2% of the
 uninstrumented loop. ISSUE 17's killswitch claim is STRUCTURAL and
 asserted on a second mini-session built under ``obs.disable()``:
@@ -101,6 +104,7 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
         anom_before = sess.anomaly.total_observed
         nm_before = sess.numerics.total_samples \
             + sess.numerics.total_skipped
+        jr_before = sess.journal.seq
         obs.enable()
         times = []
         last = None
@@ -119,6 +123,10 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
         tl_rows_per_step = (sess.timeline.total_rows - tl_before) / steps
         anom_per_step = (sess.anomaly.total_observed
                          - anom_before) / steps
+        # ops observatory (ISSUE 20): journal events are lifecycle-rare
+        # (this count is ~0 on a healthy loop — priced anyway so a
+        # regression that starts emitting per-step shows up here)
+        journal_per_step = (sess.journal.seq - jr_before) / steps
 
         def _count(snap):
             n = 0
@@ -201,13 +209,38 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
         nm_us = _unit_cost_us(one_numerics_consume)
         nm_skip_us = _unit_cost_us(
             lambda: nm_bench.observe(0, fake_off))
+        # ops observatory (ISSUE 20): the per-step terms are ONE ledger
+        # fold (on_step) and ONE alert-engine poll (clock read +
+        # compare — the throttled steady state); journal emits are
+        # event-rate-priced (journal_per_step, ~0 when healthy). The
+        # full rule pass (alert_eval_us) runs once per alert_interval_s
+        # and is amortized over the steps that interval covers.
+        jr_bench = obs.EventJournal(capacity=64,
+                                    registry=obs.MetricsRegistry())
+        journal_emit_us = _unit_cost_us(
+            lambda: jr_bench.emit("bench", "tick", n=1))
+        led_bench = obs.GoodputLedger(obs.MetricsRegistry())
+        led_row = {"step": 0, "wall_ms": 1.0, "data_wait_ms": 0.1}
+        ledger_on_step_us = _unit_cost_us(
+            lambda: led_bench.on_step(led_row))
+        al_bench = obs.AlertEngine(sess.metrics,
+                                   rules=obs.builtin_rules(),
+                                   interval_s=3600.0)
+        alert_poll_us = _unit_cost_us(al_bench.poll)
+        alert_eval_us = _unit_cost_us(al_bench.evaluate, iters=200,
+                                      batches=5)
+        evals_per_step = (step_us * 1e-6) \
+            / float(sess._config.alert_interval_s)
 
         obs_us = (spans_per_step * span_us + hist_per_step * hist_us
                   + incs_per_step * inc_us + sig_us
                   + tl_rows_per_step * tl_us + anom_per_step * anom_us
                   + mw_us + ph_us
                   + nm_samples_per_step * nm_us
-                  + (1.0 - nm_samples_per_step) * nm_skip_us)
+                  + (1.0 - nm_samples_per_step) * nm_skip_us
+                  + journal_per_step * journal_emit_us
+                  + ledger_on_step_us + alert_poll_us
+                  + evals_per_step * alert_eval_us)
         overhead_frac = obs_us / step_us
 
         # kill switch: disabled, the forensics layer must not collect
@@ -240,6 +273,15 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
             nm_bench.observe(0, fake_on)
             numerics_monitor_clean = (
                 nm_bench.total_samples + nm_bench.total_skipped == n_nm)
+            # ops observatory (ISSUE 20), per-call gates: disabled, an
+            # emit appends nothing and a ledger fold accounts nothing
+            n_jr = jr_bench.seq
+            jr_bench.emit("bench", "ghost")
+            n_led = led_bench.account()["steps"]
+            led_bench.on_step(led_row)
+            ops_calls_clean = (jr_bench.seq == n_jr
+                               and led_bench.account()["steps"]
+                               == n_led)
             # ...and a session BUILT disabled must construct no
             # consumer / replay machinery and append zero extra step
             # outputs — the engine's build-time gate, checked on the
@@ -256,6 +298,15 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
                     and sess2.numerics is None
                     and sess2._numerics_last_batch is None
                     and "numerics" not in out2)
+                # ISSUE 20 killswitch is STRUCTURAL too: a session
+                # built disabled constructs NO journal ring, NO ledger
+                # (no ops.* gauges) and NO alert engine/thread
+                ops_killswitch_clean = (
+                    ops_calls_clean
+                    and sess2.journal is None
+                    and sess2.ledger is None
+                    and sess2.alerts is None
+                    and sess2.ops_account() is None)
             finally:
                 sess2.close()
         finally:
@@ -294,6 +345,8 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
             "numerics_samples_per_step": round(nm_samples_per_step, 3),
             "numerics_consumed_per_step": round(nm_consumed_per_step,
                                                 3),
+            "journal_emits_per_step": round(journal_per_step, 3),
+            "alert_evals_per_step": round(evals_per_step, 6),
             "unit_costs_us": {"span": round(span_us, 3),
                               "histogram_record": round(hist_us, 3),
                               "counter_inc": round(inc_us, 3),
@@ -303,10 +356,17 @@ def measure(steps: int = 60, batch: int = 256, ab_segments: int = 12,
                               "memwatch_sample": round(mw_us, 3),
                               "profile_hook_idle": round(ph_us, 3),
                               "numerics_consume": round(nm_us, 3),
-                              "numerics_skip": round(nm_skip_us, 3)},
+                              "numerics_skip": round(nm_skip_us, 3),
+                              "journal_emit": round(journal_emit_us,
+                                                    3),
+                              "ledger_on_step": round(
+                                  ledger_on_step_us, 3),
+                              "alert_poll": round(alert_poll_us, 3),
+                              "alert_eval": round(alert_eval_us, 3)},
             "killswitch_clean": killswitch_clean,
             "memwatch_killswitch_clean": memwatch_killswitch_clean,
             "numerics_killswitch_clean": numerics_killswitch_clean,
+            "ops_killswitch_clean": ops_killswitch_clean,
             "ab_overhead_frac": round(ab, 4),
         }
     finally:
@@ -430,7 +490,8 @@ def main(argv=None) -> int:
     result["ok"] = (result["overhead_frac"] <= args.max_overhead
                     and result["killswitch_clean"]
                     and result["memwatch_killswitch_clean"]
-                    and result["numerics_killswitch_clean"])
+                    and result["numerics_killswitch_clean"]
+                    and result["ops_killswitch_clean"])
     if not args.skip_serve:
         result["serve"] = measure_serve()
         result["ok"] = (result["ok"]

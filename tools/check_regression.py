@@ -302,6 +302,14 @@ SECONDARY_GATES = (
     # means the decode pool did
     ("serve.disagg.ttft_ms_p99", False),
     ("serve.disagg.tokens_per_sec", True),
+    # ops observatory (ISSUE 20, bench "ops" block from
+    # tools/check_goodput.py): the clean-run goodput fraction must not
+    # quietly fall (the instrumented loop losing wall to badput —
+    # CPU-relative absolute, cross-round drift is the signal), and a
+    # full alert-rule pass over the builtin set must not creep (it is
+    # priced into the <=2% obs budget by check_obs_overhead)
+    ("ops.goodput_fraction", True),
+    ("ops.alert_eval_us", False),
 )
 
 

@@ -1,0 +1,193 @@
+"""Reconstruct "what happened to this run" from its ops artifacts.
+
+Joins the run's event journal (``Config(journal_path=...)`` JSONL)
+with a goodput account (``session.ops_account()`` JSON, or the ``ops``
+section of any flight dump) into one operator-facing report::
+
+    python tools/ops_report.py --journal run/journal.jsonl \
+        [--account run/account.json | --flight run/flight_xxx.json] \
+        [--json]
+
+The report answers the three questions an on-call asks first:
+
+* **what happened** — the causal event timeline (attempts delimited by
+  seq restarts; severity-tagged; incident ids shown so a line can be
+  joined with its flight artifact);
+* **where did the time go** — the goodput fraction and the badput
+  breakdown, naming the DOMINANT badput class (the one worth fixing
+  first);
+* **what is still wrong** — alert firings without a matching resolve.
+
+``--json`` emits the same content machine-readable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from parallax_tpu.obs.goodput import BADPUT_CLASSES, dominant_badput  # noqa: E402
+from parallax_tpu.obs.journal import read_journal  # noqa: E402
+
+
+def _load_account(args) -> dict:
+    if args.account:
+        with open(args.account) as f:
+            doc = json.load(f)
+        # accept either a bare account or the check_goodput child doc
+        return doc.get("account", doc)
+    if args.flight:
+        with open(args.flight) as f:
+            doc = json.load(f)
+        return ((doc.get("sections") or {}).get("ops")
+                or doc.get("ops") or {})
+    return {}
+
+
+def _attempts(events: list) -> list:
+    """Split the event stream at seq restarts: each process emits its
+    own monotonic seq, so a drop back to a lower seq marks a new
+    attempt (the resume appended to the same file)."""
+    attempts: list = []
+    last_seq = None
+    for e in events:
+        seq = e.get("seq", 0)
+        if last_seq is None or seq <= last_seq and seq == 1:
+            attempts.append([])
+        last_seq = seq
+        if not attempts:
+            attempts.append([])
+        attempts[-1].append(e)
+    return attempts
+
+
+def _unresolved_alerts(events: list) -> list:
+    firing: dict = {}
+    for e in events:
+        if e.get("subsystem") != "alert":
+            continue
+        name = (e.get("fields") or {}).get("alert")
+        if e.get("kind") == "firing":
+            firing[name] = e
+        elif e.get("kind") == "resolved":
+            firing.pop(name, None)
+    return sorted(firing)
+
+
+def build_report(events: list, account: dict) -> dict:
+    attempts = _attempts(events)
+    severities = {"error": 0, "warning": 0, "info": 0, "debug": 0}
+    incidents = []
+    for e in events:
+        severities[e.get("severity", "info")] = \
+            severities.get(e.get("severity", "info"), 0) + 1
+        if e.get("incident_id"):
+            incidents.append(e["incident_id"])
+    badput = dict(account.get("badput_s") or {})
+    report = {
+        "events": len(events),
+        "attempts_in_journal": len(attempts),
+        "severities": severities,
+        "incident_ids": sorted(set(incidents)),
+        "unresolved_alerts": _unresolved_alerts(events),
+        "account": {
+            "wall_s": account.get("wall_s"),
+            "goodput_fraction": account.get("goodput_fraction"),
+            "steps": account.get("steps"),
+            "attempts": account.get("attempts"),
+            "badput_s": badput,
+        } if account else None,
+        "dominant_badput": (dominant_badput(account)
+                            if account else None),
+    }
+    return report
+
+
+def _fmt_ts(ts) -> str:
+    try:
+        return time.strftime("%H:%M:%S", time.localtime(float(ts))) \
+            + f".{int((float(ts) % 1) * 1000):03d}"
+    except (TypeError, ValueError):
+        return "?"
+
+
+def render_text(events: list, account: dict, report: dict,
+                last: int = 40) -> str:
+    lines = []
+    w = lines.append
+    w("== run timeline "
+      f"({report['events']} events, "
+      f"{report['attempts_in_journal']} attempt(s) in journal) ==")
+    shown = events[-last:]
+    if len(events) > len(shown):
+        w(f"   ... {len(events) - len(shown)} earlier events elided "
+          f"(--last to widen)")
+    for e in shown:
+        sev = e.get("severity", "info")
+        mark = {"error": "!!", "warning": " !"}.get(sev, "  ")
+        extra = ""
+        if e.get("incident_id"):
+            extra += f" incident={e['incident_id']}"
+        fields = e.get("fields") or {}
+        if fields:
+            kv = " ".join(f"{k}={v}" for k, v in list(fields.items())[:5])
+            extra += f" [{kv}]"
+        w(f"{mark} {_fmt_ts(e.get('ts'))} {e.get('subsystem')}/"
+          f"{e.get('kind')}{extra}")
+    if report["account"]:
+        a = report["account"]
+        w("")
+        w("== where the wall clock went ==")
+        w(f"   wall {a['wall_s']}s over {a['attempts']} attempt(s), "
+          f"{a['steps']} steps, goodput {a['goodput_fraction']}")
+        for cls in BADPUT_CLASSES + ("unattributed",):
+            v = (a["badput_s"] or {}).get(cls)
+            if v:
+                star = " <-- dominant" \
+                    if cls == report["dominant_badput"] else ""
+                w(f"   badput {cls:<20} {v:>10.3f}s{star}")
+        if report["dominant_badput"] is None:
+            w("   no badput recorded")
+    w("")
+    if report["unresolved_alerts"]:
+        w(f"== STILL FIRING: {', '.join(report['unresolved_alerts'])} ==")
+    else:
+        w("== no unresolved alerts ==")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--journal", required=True,
+                    help="journal JSONL path (Config(journal_path=...))")
+    ap.add_argument("--account", default="",
+                    help="ops account JSON (session.ops_account())")
+    ap.add_argument("--flight", default="",
+                    help="flight dump JSON (its `ops` section is used)")
+    ap.add_argument("--last", type=int, default=40,
+                    help="timeline events to show (default 40)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    events = read_journal(args.journal)
+    if not events:
+        print(f"no events readable from {args.journal}",
+              file=sys.stderr)
+        return 1
+    account = _load_account(args)
+    report = build_report(events, account)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(render_text(events, account, report, last=args.last))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
